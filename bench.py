@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Benchmark: steady-state CIFAR-10 training throughput (images/sec/chip).
+
+Runs the flagship DDP train step (NetResDeep, per-shard batch 32 — the
+reference recipe, ``/root/reference/main.py:27,61``) on all available devices
+and prints ONE JSON line.
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
+measured against this framework's own first recorded TPU number
+(BASELINE_IMAGES_PER_SEC_PER_CHIP below): >1.0 means faster than round-1.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+# First recorded steady-state number on the round-1 flagship step
+# (TPU v5e single chip, per-shard batch 32). Later rounds compare to this.
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 400979.3
+
+
+def main() -> None:
+    from tpu_ddp.data import synthetic_cifar10
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
+    from tpu_ddp.train import create_train_state, make_optimizer, make_train_step
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = create_mesh(MeshSpec(data=-1), devices)
+
+    model = NetResDeep()
+    tx = make_optimizer(lr=1e-2)
+    state = create_train_state(model, tx, jax.random.key(0))
+    step = make_train_step(model, tx, mesh)
+
+    per_shard = 32
+    global_batch = per_shard * n_chips
+    imgs, labels = synthetic_cifar10(global_batch, seed=0)
+    batch = {
+        "image": imgs.astype(np.float32),
+        "label": labels,
+        "mask": np.ones(global_batch, bool),
+    }
+    batch = jax.device_put(batch, batch_sharding(mesh))
+
+    # warmup / compile
+    for _ in range(5):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(state.params)
+
+    n_steps = 200
+    start = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(state.params)
+    elapsed = time.perf_counter() - start
+
+    images_per_sec = n_steps * global_batch / elapsed
+    per_chip = images_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "cifar10_train_images_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+#!/usr/bin/env python
+"""Data-parallel training entry — the analogue of the reference's
+``main.py`` (mp.spawn + DDP over all local GPUs, ``/root/reference/main.py:80-85``).
+
+Here one process drives every device through a mesh; there is no spawn, no
+rank, no rendezvous. ``python main.py`` trains NetResDeep on CIFAR-10 over
+all devices with the reference recipe (SGD lr=1e-2, per-shard batch 32,
+99 epochs).
+"""
+
+import sys
+
+from tpu_ddp.cli.train import main
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

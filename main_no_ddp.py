@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Single-device baseline entry — the analogue of the reference's
+``main_no_ddp.py``. Same step function, 1-device mesh: the framework has no
+separate non-distributed code path to keep in sync (unlike the reference's
+duplicated loop, ``main_no_ddp.py:36-59``).
+
+Reference quirk preserved deliberately: its ``prepare()`` hardcodes batch 64
+with shuffle=True (``main_no_ddp.py:22,31``), so this wrapper defaults to
+batch 64 too.
+"""
+
+import sys
+
+from tpu_ddp.cli.train import main
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if not any(a.startswith("--n-devices") for a in argv):
+        argv = ["--n-devices", "1"] + argv
+    if not any(a.startswith("--batch-size") for a in argv):
+        argv = ["--batch-size", "64"] + argv
+    main(argv)

@@ -1,0 +1,34 @@
+"""Test harness: 8 virtual CPU devices (SURVEY.md §4).
+
+This is the "fake backend" the reference never had: the data-parallel step,
+mesh construction, collectives, and checkpoint sharding are all exercised on
+CPU with XLA's host-platform device-count override — no TPU required.
+
+Must run before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+# Force CPU: the session env may pin JAX_PLATFORMS to a TPU platform, and a
+# sitecustomize may have imported jax before this file runs — so set both the
+# env var (for subprocesses) and the live jax config (for this process).
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

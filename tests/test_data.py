@@ -1,0 +1,86 @@
+"""Data-layer tests: DistributedSampler-equivalent shard math (the
+disjoint-cover property the reference relies on, SURVEY.md §4), static-shape
+batching with masks, normalization constants."""
+
+import numpy as np
+import pytest
+
+from tpu_ddp.data import (
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+    ShardedBatchLoader,
+    normalize,
+    shard_indices,
+    synthetic_cifar10,
+)
+
+
+def test_shard_indices_disjoint_cover_even():
+    shards = shard_indices(64, 8, shuffle=False)
+    assert shards.shape == (8, 8)
+    assert sorted(shards.reshape(-1).tolist()) == list(range(64))
+
+
+def test_shard_indices_pads_by_wrapping():
+    # 10 samples over 4 shards -> ceil=3 each, 2 padded by wrapping (torch
+    # DistributedSampler semantics)
+    shards = shard_indices(10, 4, shuffle=False)
+    assert shards.shape == (4, 3)
+    flat = shards.reshape(-1)
+    counts = np.bincount(flat, minlength=10)
+    assert counts.sum() == 12
+    assert np.all(counts >= 1)
+
+
+def test_shard_indices_interleaved_like_torch():
+    # rank r takes order[r::ws]
+    shards = shard_indices(8, 4, shuffle=False)
+    assert shards[0].tolist() == [0, 4]
+    assert shards[1].tolist() == [1, 5]
+
+
+def test_epoch_reshuffle_and_faithful_mode():
+    imgs = np.arange(40, dtype=np.float32).reshape(40, 1, 1, 1) * np.ones((40, 2, 2, 3), np.float32)
+    labels = np.arange(40, dtype=np.int32)
+    loader = ShardedBatchLoader(
+        imgs, labels, world_size=4, per_shard_batch=4, shuffle=True
+    )
+    e1 = [b["label"].tolist() for b in loader.epoch_batches(epoch=1)]
+    e2 = [b["label"].tolist() for b in loader.epoch_batches(epoch=2)]
+    assert e1 != e2  # the set_epoch fix
+    frozen = ShardedBatchLoader(
+        imgs, labels, world_size=4, per_shard_batch=4, shuffle=True,
+        reshuffle_each_epoch=False,
+    )
+    f1 = [b["label"].tolist() for b in frozen.epoch_batches(epoch=1)]
+    f2 = [b["label"].tolist() for b in frozen.epoch_batches(epoch=2)]
+    assert f1 == f2  # faithful: reference never calls set_epoch
+
+
+def test_static_shapes_and_mask():
+    imgs, labels = synthetic_cifar10(70)
+    loader = ShardedBatchLoader(
+        imgs, labels, world_size=4, per_shard_batch=8, shuffle=False
+    )
+    # 70 -> ceil(70/4)=18 per shard -> ceil(18/8)=3 steps
+    assert loader.steps_per_epoch == 3
+    batches = list(loader)
+    shapes = {b["image"].shape for b in batches}
+    assert shapes == {(32, 32, 32, 3)}  # every batch identical shape
+    # final batch mask covers only the 2 valid rows per shard
+    last = batches[-1]["mask"].reshape(4, 8)
+    assert last[:, :2].all() and not last[:, 2:].any()
+    # masked union over the epoch covers every sample at least once
+    seen = set()
+    for b in batches:
+        seen.update(np.asarray(b["label"])[b["mask"]].tolist())
+    assert seen == set(labels.tolist())
+
+
+def test_normalize_constants_match_reference():
+    # exact constants from main.py:56-57
+    np.testing.assert_allclose(CIFAR10_MEAN, [0.4915, 0.4823, 0.4468])
+    np.testing.assert_allclose(CIFAR10_STD, [0.2470, 0.2435, 0.2616])
+    img = np.full((1, 2, 2, 3), 255, np.uint8)
+    out = normalize(img)
+    np.testing.assert_allclose(out[0, 0, 0], (1.0 - CIFAR10_MEAN) / CIFAR10_STD, rtol=1e-6)

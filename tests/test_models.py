@@ -1,0 +1,93 @@
+"""Model unit tests (SURVEY.md §4): shapes, pinned param counts, tied-weight
+semantics, init distributions."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_ddp.models import NetResDeep, ResBlock
+
+TIED_PARAM_COUNT = 76_074  # verified against the reference (SURVEY.md §2.2)
+UNTIED_PARAM_COUNT = 159_594
+
+
+def _count(tree):
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(tree))
+
+
+def _init(model, batch=2):
+    x = jnp.zeros((batch, 32, 32, 3), jnp.float32)
+    return model.init(jax.random.key(0), x, train=False), x
+
+
+@pytest.mark.parametrize(
+    "tied,expected",
+    [(True, TIED_PARAM_COUNT), (False, UNTIED_PARAM_COUNT)],
+)
+def test_param_counts(tied, expected):
+    model = NetResDeep(tied=tied)
+    variables, _ = _init(model)
+    # batch_stats (BN running mean/var) are buffers, not params, in torch's
+    # count; exclude them to match the reference's 76,074 / 159,594.
+    assert _count(variables["params"]) == expected
+
+
+def test_forward_shape():
+    model = NetResDeep()
+    variables, x = _init(model, batch=4)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (4, 10)
+    assert jnp.all(jnp.isfinite(logits))
+
+
+def test_tied_blocks_share_weights():
+    variables, _ = _init(NetResDeep(tied=True))
+    params = variables["params"]
+    # exactly one resblock param subtree in tied mode
+    block_keys = [k for k in params if k.startswith("resblock")]
+    assert block_keys == ["resblock"]
+    # and n_blocks distinct subtrees when untied
+    variables_u, _ = _init(NetResDeep(tied=False))
+    block_keys_u = sorted(k for k in variables_u["params"] if k.startswith("resblock"))
+    assert len(block_keys_u) == 10
+
+
+def test_tied_bn_stats_updated_per_application():
+    """The shared BatchNorm must accumulate running stats across all 10
+    applications per step, like the reference's shared torch module."""
+    model = NetResDeep(tied=True, n_blocks=10)
+    variables, x = _init(model, batch=8)
+    x = jax.random.normal(jax.random.key(1), x.shape)
+    _, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    mean10 = mutated["batch_stats"]["resblock"]["batch_norm"]["mean"]
+
+    model2 = NetResDeep(tied=True, n_blocks=1)
+    variables2 = model2.init(jax.random.key(0), x, train=False)
+    _, mutated2 = model2.apply(variables2, x, train=True, mutable=["batch_stats"])
+    mean1 = mutated2["batch_stats"]["resblock"]["batch_norm"]["mean"]
+    # 10 momentum updates move further from the zero init than 1 update.
+    assert float(jnp.abs(mean10).sum()) > float(jnp.abs(mean1).sum())
+
+
+def test_resblock_init_matches_reference():
+    """BN scale=0.5, BN bias=0, conv kaiming-normal std≈sqrt(2/fan_in)
+    (model/resnet.py:29-31)."""
+    block = ResBlock(n_chans=32)
+    x = jnp.zeros((2, 16, 16, 32))
+    variables = block.init(jax.random.key(0), x, train=False)
+    p = variables["params"]
+    assert jnp.all(p["batch_norm"]["scale"] == 0.5)
+    assert jnp.all(p["batch_norm"]["bias"] == 0.0)
+    kernel = p["conv"]["kernel"]
+    fan_in = 3 * 3 * 32
+    std = float(jnp.std(kernel))
+    assert abs(std - (2.0 / fan_in) ** 0.5) < 0.01
+
+
+def test_num_classes_head_swap():
+    """Variable-width head — the fine-tune capability surface
+    (ppe_main_ddp.py:104-111 swaps fc 1000->3)."""
+    model = NetResDeep(num_classes=3)
+    variables, x = _init(model)
+    assert model.apply(variables, x, train=False).shape == (2, 3)

@@ -1,0 +1,148 @@
+"""Distributed tests on the fake 8-device CPU backend (SURVEY.md §4):
+mesh construction, collectives, and the DP train step's core property —
+N devices x batch B matches 1 device x batch N*B (exact for grads/params
+because our DDP step pmean's both grads and BN stats)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_ddp.models import NetResDeep
+from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
+from tpu_ddp.parallel.collectives import ring_shift
+from tpu_ddp.data import ShardedBatchLoader, synthetic_cifar10
+from tpu_ddp.train import create_train_state, make_optimizer, make_train_step
+from tpu_ddp.train.steps import make_eval_step
+
+
+def test_mesh_spec_resolution(devices):
+    mesh = create_mesh(MeshSpec(data=-1))
+    assert mesh.shape["data"] == 8
+    assert set(mesh.axis_names) == {"data", "model", "pipeline", "sequence", "expert"}
+    mesh2 = create_mesh(MeshSpec(data=4, model=2))
+    assert mesh2.shape["data"] == 4 and mesh2.shape["model"] == 2
+    with pytest.raises(ValueError):
+        create_mesh(MeshSpec(data=3, model=3))
+
+
+def test_ring_shift(devices):
+    mesh = create_mesh(MeshSpec(data=-1))
+
+    def f(x):
+        return ring_shift(x, "data", 1)
+
+    x = jnp.arange(8.0).reshape(8, 1)
+    out = jax.shard_map(
+        f, mesh=mesh, in_specs=P("data"), out_specs=P("data")
+    )(x)
+    # value from device i lands on device (i+1) % 8
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), np.roll(np.arange(8.0), 1))
+
+
+def _run_steps(n_dev, per_shard_batch, n_steps=3, lr=0.05):
+    mesh = create_mesh(MeshSpec(data=-1), jax.devices()[:n_dev])
+    model = NetResDeep(n_blocks=2)
+    tx = make_optimizer(lr=lr)
+    state = create_train_state(model, tx, jax.random.key(0))
+    step = make_train_step(model, tx, mesh, donate=False)
+    imgs, labels = synthetic_cifar10(n_dev * per_shard_batch * n_steps, seed=3)
+    loader = ShardedBatchLoader(
+        imgs, labels, world_size=n_dev, per_shard_batch=per_shard_batch,
+        shuffle=False,
+    )
+    sharding = batch_sharding(mesh)
+    metrics = None
+    for batch in loader:
+        state, metrics = step(state, jax.device_put(batch, sharding))
+    return state, metrics
+
+
+def test_dp_matches_single_device(devices):
+    """8 devices x batch 8 == 1 device x batch 64, up to float reassociation.
+
+    Exact-parity caveat (SURVEY.md §4): per-shard BN means differ from
+    global-batch BN means, so we use interleaved shard assignment's property:
+    with shuffle=False and synthetic data the global batch CONTENT is
+    identical; BN still normalizes per shard. We therefore compare against a
+    1-device run over the same per-shard stream, i.e. semantic equivalence of
+    grads sync, not bitwise equality of different-BN runs: losses must be
+    close, params must move."""
+    state8, m8 = _run_steps(8, 8)
+    state1, m1 = _run_steps(1, 64)
+    # both runs saw the same 192 images in the same global batches; BN
+    # normalizes over 8 vs 64 samples, so trajectories agree only loosely —
+    # exact sync equality (BN off) is pinned by test_dp_grad_sync_exactness.
+    assert m8["loss"].shape == ()
+    assert abs(float(m8["loss"]) - float(m1["loss"])) < 0.6
+    assert float(m8["loss"]) < 3.0  # no divergence (double-counted grads blew
+    # up to >100 here before the pmean-the-loss fix)
+    # params stay replicated-identical across the mesh
+    p = jax.tree.leaves(state8.params)[0]
+    assert float(jnp.abs(p).sum()) > 0
+
+
+def test_dp_grad_sync_exactness(devices):
+    """With BN in eval mode there is no per-shard statistic: grads on 8x8
+    must equal grads on 1x64 exactly (up to reassociation tolerance)."""
+    model = NetResDeep(n_blocks=2)
+    tx = make_optimizer(lr=0.1)
+    state = create_train_state(model, tx, jax.random.key(0))
+    imgs, labels = synthetic_cifar10(64, seed=7)
+    batch = {
+        "image": imgs,
+        "label": labels,
+        "mask": np.ones(64, bool),
+    }
+
+    from tpu_ddp.train.losses import cross_entropy_loss
+
+    def loss_no_bn(params, batch):
+        logits = model.apply(
+            {"params": params, "batch_stats": state.batch_stats},
+            batch["image"],
+            train=False,
+        )
+        return cross_entropy_loss(logits, batch["label"], batch["mask"])
+
+    ref_grads = jax.grad(loss_no_bn)(state.params, batch)
+
+    mesh = create_mesh(MeshSpec(data=-1))
+
+    def shard_grads(params, batch):
+        # pmean the per-shard loss BEFORE grad: its AD transpose + the
+        # unvarying-params psum produce the globally averaged gradient
+        # (see tpu_ddp.train.steps docstring).
+        def global_loss(p, b):
+            return jax.lax.pmean(loss_no_bn(p, b), "data")
+
+        return jax.grad(global_loss)(params, batch)
+
+    dp_grads = jax.jit(
+        jax.shard_map(
+            shard_grads, mesh=mesh, in_specs=(P(), P("data")), out_specs=P()
+        )
+    )(state.params, batch)
+    for a, b in zip(jax.tree.leaves(ref_grads), jax.tree.leaves(dp_grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_eval_step_counts(devices):
+    mesh = create_mesh(MeshSpec(data=-1))
+    model = NetResDeep(n_blocks=1)
+    tx = make_optimizer()
+    state = create_train_state(model, tx, jax.random.key(0))
+    eval_step = make_eval_step(model, mesh)
+    imgs, labels = synthetic_cifar10(70)
+    loader = ShardedBatchLoader(
+        imgs, labels, world_size=8, per_shard_batch=4, shuffle=False
+    )
+    total = 0.0
+    sharding = batch_sharding(mesh)
+    for batch in loader:
+        out = eval_step(state, jax.device_put(batch, sharding))
+        total += float(out["count"])
+    # masked counts include wrap-padded duplicates from the sampler pad (72)
+    # but not batch-shape pad rows
+    assert total == 72.0

@@ -1,0 +1,26 @@
+"""tpu_ddp — a TPU-native distributed training framework.
+
+A ground-up re-design of the capabilities of the reference repo
+``BaamPark/DistributedDataParallel-Cifar10`` (PyTorch + NCCL DDP) for TPU
+hardware: JAX / XLA / pjit / shard_map / Pallas.
+
+Architecture (vs the reference's script layers, SURVEY.md §1):
+
+  L0 runtime    -> tpu_ddp.parallel   (Mesh over ICI/DCN, jax.distributed,
+                                       XLA collectives — replaces mp.spawn +
+                                       NCCL process groups, main.py:21-24,80-85)
+  L1 data       -> tpu_ddp.data       (raw CIFAR-10 pickles, host sharding —
+                                       replaces torchvision + DistributedSampler,
+                                       main.py:53-61)
+  L2 models     -> tpu_ddp.models     (Flax modules — replaces model/resnet.py)
+  L3 train      -> tpu_ddp.train      (one jitted step with lax.pmean grad sync —
+                                       replaces the DDP wrapper + train_loop,
+                                       main.py:26-49,63)
+  L4 cli        -> tpu_ddp.cli        (argparse entry points — replaces the
+                                       __main__ blocks)
+
+Cross-cutting: tpu_ddp.checkpoint (orbax), tpu_ddp.metrics (timers, JSONL,
+device memory stats), tpu_ddp.ops (Pallas TPU kernels).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,17 @@
+"""Checkpoint / resume (SURVEY.md §5.4) — orbax-backed.
+
+Reference behavior covered and exceeded:
+  * save: ``torch.save(model.module.state_dict(), ...)`` on log epochs
+    (``main.py:43-45``) — but here the FULL train state
+    {params, batch_stats, opt_state, step} is saved (the reference drops
+    optimizer state, lossless only because its SGD is stateless);
+  * single-writer: orbax coordinates multi-host writes, fixing the
+    every-rank-writes-one-path race at ``main.py:45``;
+  * resume: the capability the runnable reference lacks entirely;
+  * partial restore + head swap: the ``strict=False`` fine-tuning load of
+    ``ppe_main_ddp.py:104-111``, as shape-tolerant param merging.
+"""
+
+from tpu_ddp.checkpoint.manager import Checkpointer, merge_params
+
+__all__ = ["Checkpointer", "merge_params"]

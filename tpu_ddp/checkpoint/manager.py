@@ -1,0 +1,69 @@
+"""Orbax checkpoint manager + shape-tolerant restore."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+log = logging.getLogger(__name__)
+
+
+class Checkpointer:
+    """Step-keyed checkpoints of the full TrainState."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        self.manager.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self.manager.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
+        """Restore into the structure/shardings of `state_template`."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_template)
+        return self.manager.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def close(self) -> None:
+        self.manager.wait_until_finished()
+        self.manager.close()
+
+
+def merge_params(restored: Any, fresh: Any, *, verbose: bool = True) -> Any:
+    """Shape-tolerant merge: take the restored leaf where path+shape match the
+    fresh template, else keep the fresh (re-initialized) leaf.
+
+    This is ``load_state_dict(..., strict=False)`` + head-swap
+    (``ppe_main_ddp.py:104-111``) as a pure function: restoring a 10-class
+    checkpoint into a 3-class model keeps the backbone and re-initializes
+    the head.
+    """
+    restored_flat = dict(jax.tree_util.tree_flatten_with_path(restored)[0])
+    fresh_flat, treedef = jax.tree_util.tree_flatten_with_path(fresh)
+    merged = []
+    for path, fresh_leaf in fresh_flat:
+        r = restored_flat.get(path)
+        if r is not None and getattr(r, "shape", None) == fresh_leaf.shape:
+            merged.append(r)
+        else:
+            if verbose and jax.process_index() == 0:
+                why = "missing" if r is None else f"shape {r.shape} != {fresh_leaf.shape}"
+                log.info("merge_params: keeping fresh %s (%s)", jax.tree_util.keystr(path), why)
+            merged.append(fresh_leaf)
+    return jax.tree_util.tree_unflatten(treedef, [leaf for leaf in merged])

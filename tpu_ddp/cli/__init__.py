@@ -1,0 +1,4 @@
+"""Entry points (L4). One argparse CLI replaces the reference's three
+scripts: ``main.py`` (DDP), ``main_no_ddp.py`` (single device — here just
+``--n-devices 1``), and the vestigial argparse surface of
+``ppe_main_ddp.py:28-37``."""

@@ -1,0 +1,120 @@
+"""``python -m tpu_ddp.cli.train`` — the framework's training CLI.
+
+Flag surface = union of the reference's hardcoded constants (``main.py:19,
+23,27,30,61``) and the vestigial script's argparse options
+(``ppe_main_ddp.py:28-37``), per SURVEY.md §5.6.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from tpu_ddp.parallel.runtime import initialize_distributed
+from tpu_ddp.train.trainer import TrainConfig, Trainer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="tpu_ddp trainer")
+    p.add_argument("--device", choices=["cpu", "tpu", "auto"], default="auto",
+                   help="cpu forces the XLA CPU backend; tpu/auto use the "
+                        "platform JAX selected (BASELINE.json north star flag)")
+    p.add_argument("--data-dir", default="data/CIFAR-10")
+    p.add_argument("--synthetic-data", action="store_true",
+                   help="class-conditional synthetic CIFAR (no dataset needed)")
+    p.add_argument("--epochs", type=int, default=99)
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="PER-SHARD batch (reference semantics, main.py:61); "
+                        "global batch = this * n_devices")
+    p.add_argument("--global-batch-size", type=int, default=None,
+                   help="fix the GLOBAL batch instead (sane mode; divided "
+                        "across devices)")
+    p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--momentum", type=float, default=0.0)
+    p.add_argument("--weight-decay", type=float, default=0.0)
+    p.add_argument("--schedule", choices=["constant", "cosine"], default="constant")
+    p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--n-devices", type=int, default=None,
+                   help="1 == the main_no_ddp.py single-device baseline")
+    p.add_argument("--model", default="netresdeep")
+    p.add_argument("--untied-blocks", action="store_true",
+                   help="independent ResBlocks (the reference's list-repeat "
+                        "quirk ties them; see SURVEY.md §2.2)")
+    p.add_argument("--num-classes", type=int, default=10)
+    p.add_argument("--sync-bn", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--no-shuffle", action="store_true")
+    p.add_argument("--faithful-epoch-order", action="store_true",
+                   help="reproduce the missing set_epoch(): same order every epoch")
+    p.add_argument("--eval-each-epoch", action="store_true")
+    p.add_argument("--log-every-epochs", type=int, default=10)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every-epochs", type=int, default=10)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--jsonl", default=None, help="metrics JSONL path")
+    p.add_argument("--freeze", nargs="*", default=None, metavar="PREFIX",
+                   help="train ONLY params whose top module starts with one "
+                        "of these prefixes (working version of "
+                        "ppe_main_ddp.py:116-122)")
+    return p
+
+
+def config_from_args(args) -> TrainConfig:
+    import jax
+
+    if args.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    n_devices = args.n_devices
+    per_shard = args.batch_size
+    if args.global_batch_size:
+        world = n_devices or len(jax.devices())
+        assert args.global_batch_size % world == 0, (
+            f"global batch {args.global_batch_size} not divisible by {world} devices"
+        )
+        per_shard = args.global_batch_size // world
+    return TrainConfig(
+        data_dir=args.data_dir,
+        synthetic_data=args.synthetic_data,
+        epochs=args.epochs,
+        per_shard_batch=per_shard,
+        lr=args.lr,
+        momentum=args.momentum,
+        weight_decay=args.weight_decay,
+        schedule=None if args.schedule == "constant" else args.schedule,
+        warmup_steps=args.warmup_steps,
+        n_devices=n_devices,
+        seed=args.seed,
+        shuffle=not args.no_shuffle,
+        reshuffle_each_epoch=not args.faithful_epoch_order,
+        sync_bn=args.sync_bn,
+        model=args.model,
+        tied_blocks=not args.untied_blocks,
+        num_classes=args.num_classes,
+        log_every_epochs=args.log_every_epochs,
+        eval_each_epoch=args.eval_each_epoch,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every_epochs=args.checkpoint_every_epochs,
+        resume=args.resume,
+        jsonl_path=args.jsonl,
+        freeze_prefixes=tuple(args.freeze) if args.freeze else None,
+    )
+
+
+def main(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+    # Device/platform selection MUST precede any backend-touching call
+    # (initialize_distributed queries process_count): --device cpu must never
+    # initialize the TPU client.
+    config = config_from_args(args)
+    initialize_distributed()
+    trainer = Trainer(config)
+    metrics = trainer.run()
+    # Final test-set eval — the measurement the reference never takes
+    # (SURVEY.md §6: no eval loop exists upstream).
+    acc, loss = trainer.evaluate()
+    trainer.logger.log_text(f"final test accuracy: {acc:.4f}, test loss: {loss:.4f}")
+    metrics["test_accuracy"] = acc
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

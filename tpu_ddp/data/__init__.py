@@ -1,0 +1,22 @@
+"""Data layer (L1): CIFAR-10 from raw pickle batches, normalization, host
+sharding, static-shape batching. Replaces torchvision + DistributedSampler +
+DataLoader (``/root/reference/main.py:53-61``)."""
+
+from tpu_ddp.data.cifar10 import (
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+    load_cifar10,
+    synthetic_cifar10,
+    normalize,
+)
+from tpu_ddp.data.loader import ShardedBatchLoader, shard_indices
+
+__all__ = [
+    "CIFAR10_MEAN",
+    "CIFAR10_STD",
+    "load_cifar10",
+    "synthetic_cifar10",
+    "normalize",
+    "ShardedBatchLoader",
+    "shard_indices",
+]

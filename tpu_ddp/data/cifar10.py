@@ -1,0 +1,90 @@
+"""CIFAR-10 from the raw python-pickle batches — no torchvision.
+
+Mirrors ``datasets.CIFAR10(data_path, train=True, download=False, ...)`` at
+``/root/reference/main.py:53-58``: ``download=False`` semantics (the data dir
+must be pre-populated; we raise a clear error instead of silently failing),
+and the exact per-channel normalization constants from ``main.py:56-57``.
+
+Layout is NHWC float32 (TPU-native), produced once on the host; per-step work
+is slicing + device_put only.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from typing import Tuple
+
+import numpy as np
+
+# Exact constants from /root/reference/main.py:56-57.
+CIFAR10_MEAN = np.array([0.4915, 0.4823, 0.4468], np.float32)
+CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+_TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
+_TEST_FILES = ["test_batch"]
+
+
+def _find_batches_dir(data_dir: str) -> str:
+    candidates = [
+        data_dir,
+        os.path.join(data_dir, "cifar-10-batches-py"),
+        os.path.join(data_dir, "CIFAR-10", "cifar-10-batches-py"),
+    ]
+    for c in candidates:
+        if os.path.isfile(os.path.join(c, "data_batch_1")):
+            return c
+    # Auto-extract a downloaded tarball if present (torchvision leaves one).
+    for c in [data_dir, os.path.join(data_dir, "CIFAR-10")]:
+        tar = os.path.join(c, "cifar-10-python.tar.gz")
+        if os.path.isfile(tar):
+            with tarfile.open(tar) as tf:
+                tf.extractall(c)
+            return os.path.join(c, "cifar-10-batches-py")
+    raise FileNotFoundError(
+        f"CIFAR-10 batches not found under {data_dir!r} (download=False "
+        "semantics, main.py:53). Expected cifar-10-batches-py/data_batch_* "
+        "or cifar-10-python.tar.gz. Use synthetic_cifar10() for smoke runs."
+    )
+
+
+def load_cifar10(data_dir: str, train: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """Return (images float32 NHWC normalized, labels int32)."""
+    batches_dir = _find_batches_dir(data_dir)
+    imgs, labels = [], []
+    for name in _TRAIN_FILES if train else _TEST_FILES:
+        with open(os.path.join(batches_dir, name), "rb") as f:
+            d = pickle.load(f, encoding="bytes")
+        imgs.append(d[b"data"])
+        labels.extend(d[b"labels"])
+    raw = np.concatenate(imgs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return normalize(raw), np.asarray(labels, np.int32)
+
+
+def normalize(images_uint8: np.ndarray) -> np.ndarray:
+    """uint8 HWC [0,255] -> float32, /255 (ToTensor), per-channel mean/std
+    (main.py:56-57)."""
+    x = images_uint8.astype(np.float32) / 255.0
+    return (x - CIFAR10_MEAN) / CIFAR10_STD
+
+
+def synthetic_cifar10(
+    n: int = 2048, num_classes: int = 10, seed: int = 0, centers_seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic CIFAR-10-shaped synthetic data for tests and throughput
+    benchmarks (the reference has no test fixtures at all, SURVEY.md §4).
+    Images are class-conditional Gaussians so tiny models can overfit it —
+    usable for convergence smoke tests. The class centers depend only on
+    ``centers_seed``, so train/test splits drawn with different ``seed``
+    share one distribution and generalization is measurable."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+    centers = (
+        np.random.default_rng(centers_seed)
+        .normal(0.0, 1.0, size=(num_classes, 1, 1, 3))
+        .astype(np.float32)
+    )
+    imgs = rng.normal(0.0, 0.3, size=(n, 32, 32, 3)).astype(np.float32)
+    imgs += centers[labels]
+    return imgs, labels

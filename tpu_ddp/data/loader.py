@@ -1,0 +1,132 @@
+"""Sharded, static-shape batch loader.
+
+Re-implements the semantics of ``DistributedSampler`` + ``DataLoader``
+(``/root/reference/main.py:60-61``) for the SPMD world: instead of N
+processes each iterating their own rank's shard, ONE loader yields *global*
+batches laid out so that slicing the leading axis over the mesh's ``data``
+axis gives each device exactly the shard torch's sampler would have given the
+corresponding rank.
+
+Semantics preserved from torch.utils.data.DistributedSampler:
+  * pad-by-wrapping so every shard has ceil(N/ws) samples (total divisible);
+  * rank r takes padded[r::ws] (interleaved assignment);
+  * shuffle is a seeded permutation of the whole dataset before sharding.
+
+Semantics *fixed* (flagged, SURVEY.md §2.1): the reference never calls
+``sampler.set_epoch()``, so every epoch sees the identical order. Default here
+is epoch-seeded reshuffling; ``reshuffle_each_epoch=False`` reproduces the
+reference's frozen-order behavior for parity tests.
+
+Static shapes for XLA: with ``drop_last=False`` (``main.py:61``) the final
+batch is short; instead of a shape-changing remainder we pad it by wrapping
+and emit a boolean ``mask`` so the loss/metrics ignore padded rows. Every
+batch a jitted step sees has the same shape -> one compilation.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+def shard_indices(
+    n: int,
+    world_size: int,
+    *,
+    shuffle: bool,
+    seed: int = 0,
+    epoch: int = 0,
+) -> np.ndarray:
+    """(world_size, ceil(n/ws)) index matrix; row r == torch DistributedSampler
+    rank-r order (wrap-padded, interleaved)."""
+    if shuffle:
+        order = np.random.default_rng(seed + epoch).permutation(n)
+    else:
+        order = np.arange(n)
+    per_shard = math.ceil(n / world_size)
+    total = per_shard * world_size
+    if total > n:  # pad by wrapping, like DistributedSampler
+        order = np.concatenate([order, order[: total - n]])
+    return order.reshape(per_shard, world_size).T  # rank r -> order[r::ws]
+
+
+class ShardedBatchLoader:
+    """Yields dict batches {image, label, mask} of fixed global shape
+    (world_size * per_shard_batch, ...).
+
+    ``per_shard_batch`` mirrors the reference's per-process ``batch_size=32``
+    (``main.py:61``): global batch = 32 * world_size, scaling with device
+    count exactly like the reference's global batch scales with GPU count
+    (SURVEY.md §7.3 "global-vs-per-process batch semantics").
+    """
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        *,
+        world_size: int,
+        per_shard_batch: int = 32,
+        shuffle: bool = True,
+        reshuffle_each_epoch: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        assert len(images) == len(labels)
+        self.images, self.labels = images, labels
+        self.world_size = world_size
+        self.per_shard_batch = per_shard_batch
+        self.shuffle = shuffle
+        self.reshuffle_each_epoch = reshuffle_each_epoch
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+        per_shard = math.ceil(len(images) / world_size)
+        if drop_last:
+            self.steps_per_epoch = per_shard // per_shard_batch
+        else:
+            self.steps_per_epoch = math.ceil(per_shard / per_shard_batch)
+
+    @property
+    def global_batch(self) -> int:
+        return self.per_shard_batch * self.world_size
+
+    def set_epoch(self, epoch: int) -> None:
+        """The fix for the reference's missing ``sampler.set_epoch`` call."""
+        self._epoch = epoch
+
+    def epoch_batches(self, epoch: Optional[int] = None) -> Iterator[Dict[str, np.ndarray]]:
+        epoch = self._epoch if epoch is None else epoch
+        eff_epoch = epoch if self.reshuffle_each_epoch else 0
+        shards = shard_indices(
+            len(self.images),
+            self.world_size,
+            shuffle=self.shuffle,
+            seed=self.seed,
+            epoch=eff_epoch,
+        )  # (ws, per_shard)
+        per_shard = shards.shape[1]
+        bs = self.per_shard_batch
+        for step in range(self.steps_per_epoch):
+            lo, hi = step * bs, min((step + 1) * bs, per_shard)
+            chunk = shards[:, lo:hi]  # (ws, <=bs)
+            valid = hi - lo
+            if valid < bs:  # wrap-pad the short final batch; mask it out
+                pad = shards[:, : bs - valid]
+                chunk = np.concatenate([chunk, pad], axis=1)
+            idx = chunk.reshape(-1)  # global batch: shard-major layout
+            mask = np.zeros((self.world_size, bs), bool)
+            mask[:, :valid] = True
+            yield {
+                "image": self.images[idx],
+                "label": self.labels[idx],
+                "mask": mask.reshape(-1),
+            }
+
+    def __iter__(self):
+        return self.epoch_batches()
+
+    def __len__(self):
+        return self.steps_per_epoch

@@ -1,0 +1,15 @@
+"""Metrics / logging / observability (SURVEY.md §5.1, §5.5).
+
+The reference's instrumentation is bare ``print()`` from every rank plus one
+wall-clock pair around the whole run (``main.py:29,43-49``). Here:
+process-0-gated structured logging (stdout + JSONL), steady-state
+images/sec/chip, per-step timing, device memory stats (the working version of
+the dead ``free_gpu_cache``/GPUtil code, ``main.py:67-78``), and a
+``jax.profiler`` trace hook for TensorBoard/Perfetto.
+"""
+
+from tpu_ddp.metrics.logging import MetricLogger
+from tpu_ddp.metrics.timing import StepTimer, Throughput
+from tpu_ddp.metrics.memory import device_memory_stats
+
+__all__ = ["MetricLogger", "StepTimer", "Throughput", "device_memory_stats"]

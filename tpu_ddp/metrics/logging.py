@@ -1,0 +1,44 @@
+"""Structured, single-writer metric logging."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from tpu_ddp.parallel.runtime import is_primary_process
+
+
+class MetricLogger:
+    """Scalars -> stdout (+ optional JSONL file). Process-0 gated, fixing the
+    reference's every-rank-prints interleaving (``main.py:44,49``)."""
+
+    def __init__(self, jsonl_path: Optional[str] = None, stdout: bool = True):
+        self.stdout = stdout
+        self._fh = None
+        if jsonl_path and is_primary_process():
+            os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
+            self._fh = open(jsonl_path, "a", buffering=1)
+
+    def log(self, step: int, **scalars) -> None:
+        if not is_primary_process():
+            return
+        record = {"step": step, "time": time.time(), **scalars}
+        if self.stdout:
+            pretty = " ".join(
+                f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in scalars.items()
+            )
+            print(f"[step {step}] {pretty}", flush=True)
+        if self._fh:
+            self._fh.write(json.dumps(record) + "\n")
+
+    def log_text(self, msg: str) -> None:
+        if is_primary_process():
+            print(msg, flush=True)
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None

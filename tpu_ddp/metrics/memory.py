@@ -1,0 +1,27 @@
+"""Device memory diagnostics — the TPU-native replacement for the
+reference's dead GPUtil/numba GPU-cache hack (``main.py:67-78``). TPU HBM is
+managed by the XLA runtime; there is no cache to flush, only stats to read."""
+
+from __future__ import annotations
+
+import jax
+
+
+def device_memory_stats() -> list:
+    """Per-device {device, bytes_in_use, bytes_limit, ...}; empty fields on
+    backends that don't expose memory_stats (e.g. CPU)."""
+    out = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        out.append(
+            {
+                "device": str(d),
+                "bytes_in_use": stats.get("bytes_in_use"),
+                "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                "bytes_limit": stats.get("bytes_limit"),
+            }
+        )
+    return out

@@ -1,0 +1,83 @@
+"""Wall-clock and throughput instrumentation.
+
+The reference times the entire 99-epoch run with one ``time.time()`` pair
+(``main.py:29,47-49``). Here: per-step timers with warmup exclusion (first
+steps include XLA compilation) and steady-state images/sec/chip — the
+BASELINE.json driver metric. ``block_until_ready`` only at timing boundaries,
+never in the hot loop (device dispatch stays async).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+
+class StepTimer:
+    def __init__(self, warmup_steps: int = 2):
+        self.warmup_steps = warmup_steps
+        self._seen = 0
+        self._total = 0.0
+        self._steps = 0
+        self._last: Optional[float] = None
+
+    def tick(self) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self._seen += 1
+            if self._seen > self.warmup_steps:
+                self._total += now - self._last
+                self._steps += 1
+        self._last = now
+
+    @property
+    def mean_step_seconds(self) -> float:
+        return self._total / self._steps if self._steps else float("nan")
+
+
+class Throughput:
+    """Steady-state images/sec/chip over a timed region."""
+
+    def __init__(self, n_chips: Optional[int] = None):
+        self.n_chips = n_chips or jax.device_count()
+        self._images = 0
+        self._start: Optional[float] = None
+        self._elapsed = 0.0
+
+    def start(self) -> None:
+        self._start = time.perf_counter()
+
+    def add(self, n_images: int) -> None:
+        self._images += n_images
+
+    def stop(self, wait_for=None) -> None:
+        if wait_for is not None:
+            jax.block_until_ready(wait_for)
+        assert self._start is not None
+        self._elapsed += time.perf_counter() - self._start
+        self._start = None
+
+    @property
+    def images_per_sec(self) -> float:
+        return self._images / self._elapsed if self._elapsed else float("nan")
+
+    @property
+    def images_per_sec_per_chip(self) -> float:
+        return self.images_per_sec / self.n_chips
+
+
+@contextlib.contextmanager
+def profiler_trace(logdir: Optional[str]):
+    """jax.profiler trace (TensorBoard/Perfetto) around a region; no-op when
+    logdir is None."""
+    if not logdir:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,5 @@
+"""Model zoo (L2). Flax re-expressions of the reference's model layer."""
+
+from tpu_ddp.models.resnet import NetResDeep, ResBlock
+
+__all__ = ["NetResDeep", "ResBlock"]

@@ -1,0 +1,64 @@
+"""Parameter initializers matching the reference's torch semantics.
+
+The reference (``/root/reference/model/resnet.py:29-31``) uses:
+  * ``kaiming_normal_(conv.weight, nonlinearity='relu')`` on the ResBlock conv
+  * BatchNorm weight (scale) = 0.5, bias = 0
+and torch's *default* ``nn.Conv2d`` / ``nn.Linear`` init (kaiming-uniform with
+a=sqrt(5), i.e. U(-1/sqrt(fan_in), 1/sqrt(fan_in)) for both weight and bias)
+everywhere else. These are re-expressed as JAX initializers so a fixed seed
+gives the same *distribution* (JAX PRNG means bit-level equality with torch is
+neither possible nor a goal).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import nn as jnn
+from jax import random
+from jax.nn.initializers import variance_scaling
+
+# torch.nn.init.kaiming_normal_(w, nonlinearity='relu'):
+#   std = sqrt(2 / fan_in)  -> variance_scaling(scale=2, fan_in, normal)
+kaiming_normal_relu = variance_scaling(2.0, "fan_in", "normal")
+
+
+def _fan_in(shape):
+    """fan_in for conv (kh*kw*cin, flax kernel shape (kh,kw,cin,cout)) or dense ((cin,cout))."""
+    if len(shape) < 2:
+        return shape[0]
+    receptive = 1
+    for d in shape[:-2]:
+        receptive *= d
+    return receptive * shape[-2]
+
+
+def torch_default_kernel(key, shape, dtype=jnp.float32):
+    """torch's default Conv2d/Linear weight init: U(-1/sqrt(fan_in), 1/sqrt(fan_in))."""
+    bound = 1.0 / jnp.sqrt(_fan_in(shape))
+    return random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def make_torch_default_bias(fan_in: int):
+    """torch's default bias init: U(-1/sqrt(fan_in), 1/sqrt(fan_in)) (fan_in of the weight)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        bound = 1.0 / jnp.sqrt(jnp.asarray(float(fan_in), dtype))
+        return random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+    return init
+
+
+def constant(value: float):
+    def init(key, shape, dtype=jnp.float32):
+        del key
+        return jnp.full(shape, value, dtype)
+
+    return init
+
+
+__all__ = [
+    "kaiming_normal_relu",
+    "torch_default_kernel",
+    "make_torch_default_bias",
+    "constant",
+]

@@ -1,0 +1,135 @@
+"""NetResDeep — the reference's flagship model, re-expressed as Flax modules.
+
+Reference: ``/root/reference/model/resnet.py`` (NetResDeep at :5-22, ResBlock
+at :24-37). Differences by design, not omission:
+
+  * NHWC layout (TPU-native; the reference is NCHW). The flatten at
+    ``model/resnet.py:18`` (``view(-1, 8*8*n_chans1)``) becomes a plain
+    reshape — feature *ordering* inside the flat vector differs, which is
+    functionally irrelevant (the following Dense layer is permutation-
+    equivariant at init).
+  * The reference's weight-tying quirk (``model/resnet.py:10-11``:
+    ``n_blocks * [ResBlock(...)]`` repeats ONE module instance, so all 10
+    blocks share a single set of weights — verified 76,074 params, not
+    159,594) is preserved behind ``tied=True`` and fixed behind
+    ``tied=False``. Tied mode also reproduces the 10-updates-per-step
+    BatchNorm running-stat behavior, because the same BatchNorm variable is
+    written on each of the 10 calls.
+  * BatchNorm: per-replica batch stats by default (the reference has no
+    SyncBatchNorm — DDP leaves BN stats local). Pass ``bn_cross_replica_axis``
+    ("data") to sync stats across the mesh axis instead (quality option the
+    reference lacks).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tpu_ddp.models.initializers import (
+    constant,
+    kaiming_normal_relu,
+    make_torch_default_bias,
+    torch_default_kernel,
+)
+
+
+class ResBlock(nn.Module):
+    """Residual block: conv3x3(no bias) -> BN -> relu -> (+x).
+
+    Mirrors ``/root/reference/model/resnet.py:24-37`` including its init:
+    kaiming-normal(relu) conv kernel, BN scale=0.5, BN bias=0.
+    """
+
+    n_chans: int
+    bn_cross_replica_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        out = nn.Conv(
+            self.n_chans,
+            kernel_size=(3, 3),
+            padding=1,
+            use_bias=False,
+            kernel_init=kaiming_normal_relu,
+            name="conv",
+        )(x)
+        out = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,  # torch BatchNorm2d default momentum=0.1 == flax 0.9
+            epsilon=1e-5,
+            scale_init=constant(0.5),
+            bias_init=constant(0.0),
+            axis_name=self.bn_cross_replica_axis,
+            name="batch_norm",
+        )(out)
+        out = nn.relu(out)
+        return out + x
+
+
+class NetResDeep(nn.Module):
+    """conv3->32 k3p1, relu, maxpool2, n_blocks x ResBlock, maxpool2, flatten,
+    fc->32, relu, fc->num_classes. Reference: ``model/resnet.py:5-22``.
+
+    ``tied=True`` (default) reproduces the reference's shared-instance blocks;
+    ``tied=False`` gives the independent-blocks variant the reference author
+    presumably intended.
+    """
+
+    n_chans1: int = 32
+    n_blocks: int = 10
+    num_classes: int = 10
+    tied: bool = True
+    bn_cross_replica_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: (N, 32, 32, 3) NHWC
+        out = nn.Conv(
+            self.n_chans1,
+            kernel_size=(3, 3),
+            padding=1,
+            kernel_init=torch_default_kernel,
+            bias_init=make_torch_default_bias(3 * 3 * 3),
+            name="conv1",
+        )(x)
+        out = nn.max_pool(nn.relu(out), (2, 2), strides=(2, 2))  # 32x32 -> 16x16
+
+        if self.tied:
+            # One submodule applied n_blocks times == one set of weights,
+            # exactly the reference's `n_blocks * [ResBlock(...)]` list-repeat
+            # quirk (model/resnet.py:10-11). The shared BatchNorm's running
+            # stats get updated n_blocks times per step, as in the original.
+            block = ResBlock(
+                n_chans=self.n_chans1,
+                bn_cross_replica_axis=self.bn_cross_replica_axis,
+                name="resblock",
+            )
+            for _ in range(self.n_blocks):
+                out = block(out, train=train)
+        else:
+            for i in range(self.n_blocks):
+                out = ResBlock(
+                    n_chans=self.n_chans1,
+                    bn_cross_replica_axis=self.bn_cross_replica_axis,
+                    name=f"resblock_{i}",
+                )(out, train=train)
+
+        out = nn.max_pool(out, (2, 2), strides=(2, 2))  # 16x16 -> 8x8
+        out = out.reshape((out.shape[0], -1))  # (N, 8*8*n_chans1)
+        out = nn.Dense(
+            32,
+            kernel_init=torch_default_kernel,
+            bias_init=make_torch_default_bias(8 * 8 * self.n_chans1),
+            name="fc1",
+        )(out)
+        out = nn.relu(out)
+        out = nn.Dense(
+            self.num_classes,
+            kernel_init=torch_default_kernel,
+            bias_init=make_torch_default_bias(32),
+            name="fc2",
+        )(out)
+        return out  # logits; softmax lives in the loss (main.py:28 semantics)

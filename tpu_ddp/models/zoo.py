@@ -1,0 +1,16 @@
+"""Model registry — the scale-out families from BASELINE.json configs 2-4
+(ResNet-18/50/101 for CIFAR-100/ImageNet, ViT stretch) register here as they
+land. ``NetResDeep`` is special-cased in the trainer since its constructor
+carries the tied-blocks flag."""
+
+from __future__ import annotations
+
+MODEL_REGISTRY: dict = {}
+
+
+def register(name: str):
+    def deco(factory):
+        MODEL_REGISTRY[name] = factory
+        return factory
+
+    return deco

@@ -1,0 +1,42 @@
+"""Distributed runtime (L0): device mesh, XLA collectives, process bootstrap.
+
+Replaces the reference's entire distributed stack — ``setup()``/NCCL process
+groups (``main.py:21-24``), ``mp.spawn`` process-per-GPU (``main.py:80-85``),
+and the DDP wrapper's hidden allreduce (``main.py:63``) — with JAX's SPMD
+model: one process per host, a ``jax.sharding.Mesh`` over all devices, and
+explicit XLA collectives (``lax.pmean``) inside the jitted step.
+"""
+
+from tpu_ddp.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    PIPELINE_AXIS,
+    SEQUENCE_AXIS,
+    EXPERT_AXIS,
+    MeshSpec,
+    create_mesh,
+    batch_sharding,
+    replicated_sharding,
+)
+from tpu_ddp.parallel.runtime import (
+    initialize_distributed,
+    is_primary_process,
+    device_count,
+    local_device_count,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "PIPELINE_AXIS",
+    "SEQUENCE_AXIS",
+    "EXPERT_AXIS",
+    "MeshSpec",
+    "create_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "initialize_distributed",
+    "is_primary_process",
+    "device_count",
+    "local_device_count",
+]

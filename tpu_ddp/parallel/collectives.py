@@ -1,0 +1,66 @@
+"""Named-axis collective wrappers.
+
+The reference's only collective is NCCL allreduce hidden inside DDP backward
+hooks (``main.py:38,63``; SURVEY.md §3.3). Here collectives are explicit,
+traceable ops lowered by XLA:TPU onto ICI (intra-slice) / DCN (cross-slice),
+with comm/compute overlap handled by XLA's latency-hiding scheduler — the
+in-tree replacement for DDP's C++ bucketing Reducer (SURVEY.md §2.6).
+
+These are thin, named wrappers so call sites read as intent ("sync grads")
+rather than mechanism; all of them are only valid inside shard_map/vmap with
+the axis bound.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def psum(x, axis: str):
+    return lax.psum(x, axis_name=axis)
+
+
+def pmean(x, axis: str):
+    return lax.pmean(x, axis_name=axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = True):
+    return lax.all_gather(x, axis_name=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_dimension: int = 0):
+    return lax.psum_scatter(x, axis_name=axis, scatter_dimension=scatter_dimension, tiled=True)
+
+
+def ppermute(x, axis: str, perm):
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def ring_shift(x, axis: str, shift: int = 1):
+    """Shift values around the ring on `axis` (neighbor exchange over ICI).
+    Building block for ring attention / pipeline microbatch handoff."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name=axis, perm=perm)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)
+
+
+def sync_gradients(grads, axis: str):
+    """Gradient all-reduce-mean over the data axis — the explicit, one-line
+    replacement for the reference's entire NCCL/DDP machinery (main.py:63).
+
+    NOTE: only for grads that are still per-shard (varying), e.g. computed
+    w.r.t. *sharded* params or outside shard_map's AD. Under shard_map,
+    differentiating w.r.t. replicated (unvarying) params already psums the
+    cotangents — pmean-ing those again double-counts. The train step in
+    tpu_ddp.train.steps instead pmeans the LOSS before AD, which yields the
+    allreduce-mean'd gradient directly."""
+    return jax.tree.map(lambda g: lax.pmean(g, axis_name=axis), grads)

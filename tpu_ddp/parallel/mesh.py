@@ -1,0 +1,100 @@
+"""Device mesh construction and sharding helpers.
+
+The mesh is N-dimensional from day one (SURVEY.md §5.7): the reference only
+exercises data parallelism, but ``MeshSpec`` reserves named axes for tensor,
+pipeline, sequence, and expert parallelism so scaling out is a config change,
+not a redesign. Collectives ride ICI within a pod slice and DCN across pods —
+axis order puts ``data`` outermost (DCN-friendly) and ``model`` innermost
+(ICI-friendly), per the standard TPU sharding recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+SEQUENCE_AXIS = "sequence"
+PIPELINE_AXIS = "pipeline"
+MODEL_AXIS = "model"
+EXPERT_AXIS = "expert"
+
+# Outermost-to-innermost: cross-host friendly axes first, ICI-hungry last.
+AXIS_ORDER = (DATA_AXIS, PIPELINE_AXIS, EXPERT_AXIS, SEQUENCE_AXIS, MODEL_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Sizes for each mesh axis; -1 on exactly one axis means "all remaining
+    devices". Axes of size 1 are kept in the mesh (free to re-use later)."""
+
+    data: int = -1
+    pipeline: int = 1
+    expert: int = 1
+    sequence: int = 1
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> dict:
+        sizes = {
+            DATA_AXIS: self.data,
+            PIPELINE_AXIS: self.pipeline,
+            EXPERT_AXIS: self.expert,
+            SEQUENCE_AXIS: self.sequence,
+            MODEL_AXIS: self.model,
+        }
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis, got {wild}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh wants {fixed} devices, have {n_devices}")
+        return sizes
+
+
+def create_mesh(
+    spec: MeshSpec | None = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh over the given (default: all) devices.
+
+    Replaces the reference's world-size discovery + per-rank process spawn
+    (``main.py:80-84``): here one process addresses every device through a
+    single mesh, and "rank" is just a coordinate on the ``data`` axis.
+    """
+    spec = spec or MeshSpec()
+    devices = list(devices) if devices is not None else jax.devices()
+    sizes = spec.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def data_parallel_mesh(n: Optional[int] = None) -> Mesh:
+    devices = jax.devices()[:n] if n else None
+    return create_mesh(MeshSpec(data=-1), devices)
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard the leading (batch) dimension over `axis`; replicate the rest.
+
+    This single annotation replaces the reference's ``DistributedSampler``
+    rank math + per-process loaders (``main.py:60-61``) at the device level.
+    """
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated — the reference's DDP model replication
+    (``main.py:62-63``) without the wrapper or the ctor broadcast."""
+    return NamedSharding(mesh, P())

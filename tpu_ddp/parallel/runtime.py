@@ -1,0 +1,70 @@
+"""Process/runtime bootstrap.
+
+Replaces ``setup()`` (``/root/reference/main.py:21-24``: MASTER_ADDR/PORT env
+rendezvous + ``init_process_group("nccl")``) and the process-per-GPU spawn
+(``main.py:80-85``). On TPU, a single process drives all local chips; multi-
+host pods launch one process per host, coordinated by
+``jax.distributed.initialize`` — there is no per-device rank plumbing and no
+torch.multiprocessing equivalent, by design (SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    force: bool = False,
+) -> None:
+    """Multi-host bootstrap. No-op on a single host (unlike the reference,
+    which *requires* its rendezvous even for one machine, main.py:22-24).
+
+    On multi-host TPU pods pass ``force=True`` (args are auto-detected from
+    pod metadata) or give explicit coordinator args. With neither, this is a
+    no-op that does NOT touch any backend — platform selection may not have
+    happened yet, and forcing backend creation here would pin the wrong one.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None and num_processes is None and not force:
+        _initialized = True
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info(
+        "jax.distributed initialized: process %d/%d, %d local / %d global devices",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+    )
+
+
+def is_primary_process() -> bool:
+    """Single-writer predicate (process 0). Fixes the reference's
+    all-ranks-write-one-checkpoint race (``main.py:45``) and interleaved
+    logging (``main.py:44,49``) — SURVEY.md §5.2."""
+    return jax.process_index() == 0
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()

@@ -1,0 +1,46 @@
+"""Losses and metrics.
+
+``cross_entropy_loss`` is the ``nn.CrossEntropyLoss()`` of the reference
+(``main.py:28``: softmax folded into the loss, mean reduction), extended with
+an optional validity mask so statically-shaped padded batches (drop_last=False
+semantics, ``main.py:61``) contribute only their real rows.
+
+``binary_cross_entropy_with_logits`` covers the multi-label fine-tuning
+workload of the vestigial script (``ppe_main_ddp.py:147``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(logits, labels, mask: Optional[jnp.ndarray] = None):
+    log_probs = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(log_probs, labels[:, None], axis=-1)[:, 0]
+    if mask is None:
+        return nll.mean()
+    mask = mask.astype(nll.dtype)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def binary_cross_entropy_with_logits(logits, targets, mask: Optional[jnp.ndarray] = None):
+    per = jnp.maximum(logits, 0) - logits * targets + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    per = per.mean(axis=-1)
+    if mask is None:
+        return per.mean()
+    mask = mask.astype(per.dtype)
+    return (per * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def masked_accuracy(logits, labels, mask: Optional[jnp.ndarray] = None):
+    """(correct_count, valid_count) — summable across shards/batches. The
+    eval metric the reference never computes (SURVEY.md §6)."""
+    pred = jnp.argmax(logits, axis=-1)
+    correct = (pred == labels).astype(jnp.float32)
+    if mask is None:
+        return correct.sum(), jnp.asarray(correct.size, jnp.float32)
+    mask = mask.astype(jnp.float32)
+    return (correct * mask).sum(), mask.sum()

@@ -1,0 +1,40 @@
+"""Train state: the framework's single source of truth for training.
+
+A superset of what the reference persists: it saves only
+``model.state_dict()`` (``main.py:45``) and silently drops optimizer state —
+lossless there only because plain SGD is stateless. Here
+``{step, params, batch_stats, opt_state}`` travel together (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+
+def create_train_state(model, tx, rng, input_shape=(1, 32, 32, 3)) -> TrainState:
+    variables = model.init(rng, jnp.zeros(input_shape, jnp.float32), train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+    )
+
+
+def param_count(state: TrainState) -> int:
+    import numpy as np
+
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(state.params))

@@ -1,0 +1,225 @@
+"""Trainer: the orchestration loop.
+
+Covers ``train_loop``/``main`` (``/root/reference/main.py:26-65``) and the
+single-device baseline (``main_no_ddp.py:36-59``) with ONE code path: the
+single-device mode is just a 1-device mesh — no separate script, no DDP
+wrapper to add or remove.
+
+Reference cadence preserved: epochs 1..epochs (``range(1, 100)`` = 99,
+``main.py:30``), mean-loss log + checkpoint at epoch 1 and every
+``log_every`` epochs (``main.py:43-45``), total wall-clock print
+(``main.py:47-49``). Extended (SURVEY.md gaps): test-set eval, per-step
+timing, images/sec/chip, JSONL metrics, resume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from tpu_ddp.data.loader import ShardedBatchLoader
+from tpu_ddp.metrics import MetricLogger, StepTimer, Throughput
+from tpu_ddp.parallel.mesh import DATA_AXIS, MeshSpec, batch_sharding, create_mesh
+from tpu_ddp.train.optim import make_optimizer
+from tpu_ddp.train.state import create_train_state
+from tpu_ddp.train.steps import make_eval_step, make_train_step
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Union of the reference's hardcoded constants and the vestigial
+    script's argparse surface (SURVEY.md §5.6), as one dataclass."""
+
+    data_dir: str = "data/CIFAR-10"      # main.py:19
+    synthetic_data: bool = False          # no torchvision download path
+    synthetic_size: int = 2048
+    epochs: int = 99                      # range(1,100), main.py:30
+    per_shard_batch: int = 32             # per-process bs, main.py:61
+    lr: float = 1e-2                      # main.py:27
+    momentum: float = 0.0                 # reference SGD has none
+    weight_decay: float = 0.0
+    schedule: Optional[str] = None        # "cosine" | None
+    warmup_steps: int = 0
+    n_devices: Optional[int] = None       # None = all; 1 = main_no_ddp mode
+    seed: int = 0
+    shuffle: bool = True
+    reshuffle_each_epoch: bool = True     # False = faithful missing-set_epoch
+    sync_bn: bool = False
+    model: str = "netresdeep"
+    tied_blocks: bool = True              # the reference's weight-tying quirk
+    num_classes: int = 10
+    log_every_epochs: int = 10            # main.py:43
+    eval_each_epoch: bool = False
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every_epochs: int = 10     # save on log epochs, main.py:45
+    resume: bool = False
+    jsonl_path: Optional[str] = None
+    freeze_prefixes: Optional[tuple] = None  # e.g. ("fc",) trains head only
+
+
+def build_model(config: TrainConfig):
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+
+    bn_axis = DATA_AXIS if config.sync_bn else None
+    name = config.model.lower()
+    if name == "netresdeep":
+        return NetResDeep(
+            tied=config.tied_blocks,
+            num_classes=config.num_classes,
+            bn_cross_replica_axis=bn_axis,
+        )
+    if name in MODEL_REGISTRY:
+        return MODEL_REGISTRY[name](
+            num_classes=config.num_classes, bn_cross_replica_axis=bn_axis
+        )
+    raise ValueError(f"unknown model {config.model!r}")
+
+
+class Trainer:
+    def __init__(self, config: TrainConfig):
+        self.config = config
+        devices = jax.devices()
+        if config.n_devices:
+            devices = devices[: config.n_devices]
+        self.mesh = create_mesh(MeshSpec(data=-1), devices)
+        self.world_size = len(devices)
+        self.batch_sharding = batch_sharding(self.mesh)
+
+        self.model = build_model(config)
+        self._load_data()
+        total_steps = self.train_loader.steps_per_epoch * config.epochs
+        freeze = None
+        if config.freeze_prefixes:
+            from tpu_ddp.train.optim import freeze_all_but
+
+            freeze = freeze_all_but(tuple(config.freeze_prefixes))
+        self.tx = make_optimizer(
+            lr=config.lr,
+            momentum=config.momentum,
+            weight_decay=config.weight_decay,
+            schedule=config.schedule,
+            total_steps=total_steps,
+            warmup_steps=config.warmup_steps,
+            freeze_predicate=freeze,
+        )
+        self.state = create_train_state(
+            self.model, self.tx, jax.random.key(config.seed)
+        )
+        self.train_step = make_train_step(self.model, self.tx, self.mesh)
+        self.eval_step = make_eval_step(self.model, self.mesh)
+        self.logger = MetricLogger(jsonl_path=config.jsonl_path)
+
+        self.checkpointer = None
+        if config.checkpoint_dir:
+            from tpu_ddp.checkpoint import Checkpointer
+
+            self.checkpointer = Checkpointer(config.checkpoint_dir)
+            if config.resume and self.checkpointer.latest_step() is not None:
+                from tpu_ddp.parallel.mesh import replicated_sharding
+
+                restored = self.checkpointer.restore(self.state)
+                # Restored arrays come back committed to one device; the
+                # train step needs them replicated across the mesh.
+                self.state = jax.device_put(
+                    restored, replicated_sharding(self.mesh)
+                )
+                self.logger.log_text(
+                    f"resumed from step {int(self.state.step)}"
+                )
+
+    def _load_data(self):
+        c = self.config
+        if c.synthetic_data:
+            from tpu_ddp.data.cifar10 import synthetic_cifar10
+
+            train = synthetic_cifar10(c.synthetic_size, c.num_classes, c.seed)
+            test = synthetic_cifar10(max(c.synthetic_size // 5, 64), c.num_classes, c.seed + 1)
+        else:
+            from tpu_ddp.data.cifar10 import load_cifar10
+
+            train = load_cifar10(c.data_dir, train=True)
+            test = load_cifar10(c.data_dir, train=False)
+        self.train_loader = ShardedBatchLoader(
+            *train,
+            world_size=self.world_size,
+            per_shard_batch=c.per_shard_batch,
+            shuffle=c.shuffle,
+            reshuffle_each_epoch=c.reshuffle_each_epoch,
+            seed=c.seed,
+        )
+        self.test_loader = ShardedBatchLoader(
+            *test,
+            world_size=self.world_size,
+            per_shard_batch=c.per_shard_batch,
+            shuffle=False,
+        )
+
+    def _put(self, batch):
+        return jax.device_put(batch, self.batch_sharding)
+
+    def run(self) -> dict:
+        c = self.config
+        start = time.time()
+        timer = StepTimer(warmup_steps=2)
+        throughput = Throughput(n_chips=self.world_size)
+        throughput.start()
+        last_metrics = {}
+        start_epoch = int(self.state.step) // self.train_loader.steps_per_epoch
+        for epoch in range(start_epoch + 1, c.epochs + 1):
+            self.train_loader.set_epoch(epoch)
+            loss_sum, n_batches = 0.0, 0
+            epoch_metrics = None
+            for batch in self.train_loader:
+                timer.tick()
+                self.state, epoch_metrics = self.train_step(
+                    self.state, self._put(batch)
+                )
+                throughput.add(int(batch["mask"].sum()))
+                loss_sum += float(epoch_metrics["loss"])
+                n_batches += 1
+            if epoch == 1 or epoch % c.log_every_epochs == 0:
+                mean_loss = loss_sum / max(n_batches, 1)
+                # reference log line shape: main.py:43-44
+                self.logger.log_text(
+                    f"Epoch {epoch}, Training loss {mean_loss}"
+                )
+                self.logger.log(
+                    int(self.state.step),
+                    epoch=epoch,
+                    train_loss=mean_loss,
+                    train_accuracy=float(epoch_metrics["accuracy"]),
+                )
+                if self.checkpointer and epoch % c.checkpoint_every_epochs in (0, 1):
+                    self.checkpointer.save(int(self.state.step), self.state)
+            if c.eval_each_epoch:
+                acc, loss = self.evaluate()
+                self.logger.log(int(self.state.step), test_accuracy=acc, test_loss=loss)
+                last_metrics["test_accuracy"] = acc
+        throughput.stop(wait_for=self.state.params)
+        total = time.time() - start
+        # reference wall-clock line: main.py:49
+        self.logger.log_text(f"training time: {total:.3f} seconds")
+        if self.checkpointer:
+            self.checkpointer.save(int(self.state.step), self.state, wait=True)
+        last_metrics.update(
+            total_seconds=total,
+            mean_step_seconds=timer.mean_step_seconds,
+            images_per_sec=throughput.images_per_sec,
+            images_per_sec_per_chip=throughput.images_per_sec_per_chip,
+        )
+        return last_metrics
+
+    def evaluate(self) -> tuple:
+        """Test-set accuracy/loss — the eval loop the reference never had."""
+        correct = count = loss_sum = 0.0
+        for batch in self.test_loader.epoch_batches(epoch=0):
+            out = self.eval_step(self.state, self._put(batch))
+            correct += float(out["correct"])
+            count += float(out["count"])
+            loss_sum += float(out["loss_sum"])
+        return correct / max(count, 1.0), loss_sum / max(count, 1.0)
