@@ -1,0 +1,175 @@
+"""Capability-parity tests for the vestigial-script surface (SURVEY.md §2.4):
+fine-tuning (partial restore + head swap), WORKING layer freezing, k-fold
+splits, mAP evaluation, plotting, prediction dumps, checkpoint resume."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_ddp.checkpoint import Checkpointer, merge_params
+from tpu_ddp.data import synthetic_cifar10, synthetic_multilabel
+from tpu_ddp.models import NetResDeep
+from tpu_ddp.train import create_train_state, make_optimizer
+from tpu_ddp.train.kfold import kfold_split
+from tpu_ddp.metrics.evaluation import (
+    average_precision,
+    mean_average_precision,
+    multilabel_predictions,
+    precision_recall_curve,
+)
+
+
+def test_merge_params_head_swap():
+    """10-class checkpoint into 3-class model: backbone kept, head fresh —
+    load_state_dict(strict=False) + fc swap (ppe_main_ddp.py:104-111)."""
+    tx = make_optimizer()
+    old = create_train_state(NetResDeep(num_classes=10), tx, jax.random.key(0))
+    new = create_train_state(NetResDeep(num_classes=3), tx, jax.random.key(1))
+    merged = merge_params(old.params, new.params)
+    # backbone conv taken from the checkpoint
+    np.testing.assert_array_equal(
+        merged["conv1"]["kernel"], old.params["conv1"]["kernel"]
+    )
+    # head kept fresh (shapes differ)
+    assert merged["fc2"]["kernel"].shape == (32, 3)
+    np.testing.assert_array_equal(
+        merged["fc2"]["kernel"], new.params["fc2"]["kernel"]
+    )
+
+
+def test_freeze_mask_actually_freezes():
+    """The reference's freeze loop is a silent no-op (required_grad typo,
+    ppe_main_ddp.py:116-122). Ours must provably zero frozen updates."""
+    import optax
+
+    from tpu_ddp.train.optim import freeze_all_but
+
+    model = NetResDeep(n_blocks=1)
+    tx = make_optimizer(lr=0.1, freeze_predicate=freeze_all_but(("fc",)))
+    state = create_train_state(model, tx, jax.random.key(0))
+    grads = jax.tree.map(jnp.ones_like, state.params)
+    updates, _ = tx.update(grads, state.opt_state, state.params)
+    # frozen backbone: zero updates
+    assert float(jnp.abs(updates["conv1"]["kernel"]).sum()) == 0.0
+    assert float(jnp.abs(updates["resblock"]["conv"]["kernel"]).sum()) == 0.0
+    # trainable head: nonzero updates
+    assert float(jnp.abs(updates["fc1"]["kernel"]).sum()) > 0.0
+    assert float(jnp.abs(updates["fc2"]["kernel"]).sum()) > 0.0
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    tx = make_optimizer(momentum=0.9)  # stateful: opt_state must survive
+    state = create_train_state(NetResDeep(n_blocks=1), tx, jax.random.key(0))
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    ckpt.save(7, state, wait=True)
+    assert ckpt.latest_step() == 7
+    restored = ckpt.restore(state)
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ckpt.close()
+
+
+def test_finetune_load(tmp_path):
+    """End-to-end fine-tune load: save 10-class, restore into 3-class."""
+    from tpu_ddp.train.finetune import load_pretrained_for_finetune
+
+    tx = make_optimizer()
+    pre = create_train_state(NetResDeep(num_classes=10), tx, jax.random.key(0))
+    ckpt = Checkpointer(str(tmp_path / "pre"))
+    ckpt.save(1, pre, wait=True)
+    ckpt.close()
+
+    ft = load_pretrained_for_finetune(
+        str(tmp_path / "pre"), NetResDeep(num_classes=3), tx
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ft.params["conv1"]["kernel"]),
+        np.asarray(pre.params["conv1"]["kernel"]),
+    )
+    assert ft.params["fc2"]["kernel"].shape == (32, 3)
+    assert int(ft.step) == 0  # fresh optimizer/step for fine-tuning
+
+
+def test_kfold_split_properties():
+    folds = kfold_split(103, 5, seed=1)
+    assert len(folds) == 5
+    all_val = np.concatenate([v for _, v in folds])
+    assert sorted(all_val.tolist()) == list(range(103))  # disjoint cover
+    for train, val in folds:
+        assert set(train) & set(val) == set()
+        assert len(train) + len(val) == 103
+    with pytest.raises(ValueError):
+        kfold_split(10, 1)
+
+
+def test_average_precision_known_values():
+    scores = np.array([0.9, 0.8, 0.7, 0.6])
+    targets = np.array([1, 0, 1, 0])
+    # ranks: pos@1 (P=1), pos@3 (P=2/3) -> AP = (1 + 2/3)/2
+    assert abs(average_precision(scores, targets) - (1 + 2 / 3) / 2) < 1e-9
+    # perfect ranking
+    assert average_precision(np.array([0.9, 0.1]), np.array([1, 0])) == 1.0
+    # no positives -> nan, excluded from mAP
+    out = mean_average_precision(
+        np.array([[0.9, 0.2], [0.1, 0.8]]), np.array([[1, 0], [0, 0]])
+    )
+    assert not np.isnan(out["mAP"])
+    assert np.isnan(out["per_class_ap"][1])
+
+
+def test_precision_recall_and_threshold():
+    scores = np.array([0.9, 0.6, 0.3])
+    targets = np.array([1, 1, 0])
+    p, r, _ = precision_recall_curve(scores, targets)
+    np.testing.assert_allclose(r[-1], 1.0)
+    preds = multilabel_predictions(np.array([[0.6, 0.4]]))
+    np.testing.assert_array_equal(preds, [[1, 0]])
+
+
+def test_plotting_writes_png(tmp_path):
+    from tpu_ddp.metrics.plotting import plot_loss_curves, plot_precision_recall
+
+    out = plot_loss_curves(
+        {"train_loss": [2.0, 1.0, 0.5], "test_loss": [2.1, 1.2, 0.8]},
+        str(tmp_path / "loss.png"),
+    )
+    assert os.path.getsize(out) > 1000
+    out2 = plot_precision_recall(
+        np.array([1.0, 0.8, 0.6]), np.array([0.2, 0.6, 1.0]), str(tmp_path / "pr.png")
+    )
+    assert os.path.getsize(out2) > 1000
+
+
+def test_synthetic_multilabel_shapes():
+    imgs, targets = synthetic_multilabel(32, num_classes=3)
+    assert imgs.shape == (32, 32, 32, 3)
+    assert targets.shape == (32, 3)
+    assert set(np.unique(targets)) <= {0.0, 1.0}
+
+
+def test_trainer_bce_and_predict(devices):
+    """Multi-label BCE training + sharded batch inference end-to-end on the
+    8-device mesh."""
+    from tpu_ddp.train.trainer import TrainConfig, Trainer
+
+    imgs, targets = synthetic_multilabel(128, num_classes=3, seed=0)
+    cfg = TrainConfig(
+        synthetic_data=True,
+        epochs=2,
+        per_shard_batch=4,
+        num_classes=3,
+        loss="bce",
+        log_every_epochs=100,
+        eval_each_epoch=False,
+    )
+    tr = Trainer(cfg, train_data=(imgs, targets), test_data=(imgs[:48], targets[:48]))
+    metrics = tr.run()
+    assert np.isfinite(metrics["images_per_sec"])
+    logits, labels = tr.predict()
+    assert logits.shape == (48, 3) and labels.shape == (48, 3)
+    scores = 1 / (1 + np.exp(-logits))
+    out = mean_average_precision(scores, labels)
+    assert np.isfinite(out["mAP"])

@@ -84,3 +84,39 @@ def test_normalize_constants_match_reference():
     img = np.full((1, 2, 2, 3), 255, np.uint8)
     out = normalize(img)
     np.testing.assert_allclose(out[0, 0, 0], (1.0 - CIFAR10_MEAN) / CIFAR10_STD, rtol=1e-6)
+
+
+def test_short_dataset_pad_smaller_than_batch():
+    """Pad deficit larger than the per-shard sample count must tile, not
+    truncate (regression: 102 samples, 8 shards, batch 32 -> 13/shard,
+    deficit 19 > 13)."""
+    from tpu_ddp.data import synthetic_cifar10
+
+    imgs, labels = synthetic_cifar10(102)
+    loader = ShardedBatchLoader(
+        imgs, labels, world_size=8, per_shard_batch=32, shuffle=False
+    )
+    batches = list(loader)
+    assert len(batches) == 1
+    assert batches[0]["image"].shape == (256, 32, 32, 3)
+    mask = batches[0]["mask"].reshape(8, 32)
+    assert mask[:, :13].all() and not mask[:, 13:].any()
+
+
+def test_exclude_sampler_pad_mask():
+    """Eval loaders mask sampler wrap-pad duplicates so each sample counts
+    exactly once (70 samples, 8 shards -> 2 duplicates masked)."""
+    from tpu_ddp.data import synthetic_cifar10
+
+    imgs, labels = synthetic_cifar10(70)
+    loader = ShardedBatchLoader(
+        imgs, labels, world_size=8, per_shard_batch=4, shuffle=False,
+        exclude_sampler_pad=True,
+    )
+    total = sum(int(b["mask"].sum()) for b in loader)
+    assert total == 70
+    # and every sample appears exactly once among valid rows
+    seen = []
+    for b in loader:
+        seen.extend(np.asarray(b["label"])[b["mask"]].tolist())
+    assert sorted(seen) == sorted(labels.tolist())

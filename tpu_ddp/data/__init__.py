@@ -7,6 +7,7 @@ from tpu_ddp.data.cifar10 import (
     CIFAR10_STD,
     load_cifar10,
     synthetic_cifar10,
+    synthetic_multilabel,
     normalize,
 )
 from tpu_ddp.data.loader import ShardedBatchLoader, shard_indices
@@ -16,6 +17,7 @@ __all__ = [
     "CIFAR10_STD",
     "load_cifar10",
     "synthetic_cifar10",
+    "synthetic_multilabel",
     "normalize",
     "ShardedBatchLoader",
     "shard_indices",

@@ -88,3 +88,21 @@ def synthetic_cifar10(
     imgs = rng.normal(0.0, 0.3, size=(n, 32, 32, 3)).astype(np.float32)
     imgs += centers[labels]
     return imgs, labels
+
+
+def synthetic_multilabel(
+    n: int = 512, num_classes: int = 3, seed: int = 0, centers_seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Synthetic multi-label data (images, multi-hot float32 targets) for the
+    BCE fine-tuning workload (the reference's PPE detection surface,
+    ppe_main_ddp.py:147). Each active class adds its center signal."""
+    rng = np.random.default_rng(seed)
+    targets = (rng.random((n, num_classes)) < 0.35).astype(np.float32)
+    centers = (
+        np.random.default_rng(centers_seed)
+        .normal(0.0, 1.0, size=(num_classes, 1, 1, 3))
+        .astype(np.float32)
+    )
+    imgs = rng.normal(0.0, 0.3, size=(n, 32, 32, 3)).astype(np.float32)
+    imgs += np.einsum("nc,chwk->nhwk", targets, centers)
+    return imgs, targets

@@ -73,7 +73,13 @@ class ShardedBatchLoader:
         reshuffle_each_epoch: bool = True,
         seed: int = 0,
         drop_last: bool = False,
+        exclude_sampler_pad: bool = False,
     ):
+        """exclude_sampler_pad: also mask out the sampler-level wrap-pad
+        duplicates (the samples DistributedSampler repeats to even out
+        shards). Keep False for training (torch trains on the duplicates —
+        faithful semantics); set True for eval/predict loaders so metrics
+        count every sample exactly once."""
         assert len(images) == len(labels)
         self.images, self.labels = images, labels
         self.world_size = world_size
@@ -82,6 +88,7 @@ class ShardedBatchLoader:
         self.reshuffle_each_epoch = reshuffle_each_epoch
         self.seed = seed
         self.drop_last = drop_last
+        self.exclude_sampler_pad = exclude_sampler_pad
         self._epoch = 0
         per_shard = math.ceil(len(images) / world_size)
         if drop_last:
@@ -108,17 +115,27 @@ class ShardedBatchLoader:
             epoch=eff_epoch,
         )  # (ws, per_shard)
         per_shard = shards.shape[1]
+        n = len(self.images)
+        # positions >= n in the padded order are sampler wrap-pad duplicates
+        # (mirrors the reshape in shard_indices)
+        total = per_shard * self.world_size
+        is_real = (np.arange(total) < n).reshape(per_shard, self.world_size).T
         bs = self.per_shard_batch
         for step in range(self.steps_per_epoch):
             lo, hi = step * bs, min((step + 1) * bs, per_shard)
             chunk = shards[:, lo:hi]  # (ws, <=bs)
+            real = is_real[:, lo:hi]
             valid = hi - lo
             if valid < bs:  # wrap-pad the short final batch; mask it out
-                pad = shards[:, : bs - valid]
+                deficit = bs - valid
+                reps = -(-deficit // per_shard)  # ceil: shard may be shorter
+                pad = np.tile(shards, (1, reps))[:, :deficit]
                 chunk = np.concatenate([chunk, pad], axis=1)
             idx = chunk.reshape(-1)  # global batch: shard-major layout
             mask = np.zeros((self.world_size, bs), bool)
             mask[:, :valid] = True
+            if self.exclude_sampler_pad:
+                mask[:, :valid] &= real
             yield {
                 "image": self.images[idx],
                 "label": self.labels[idx],

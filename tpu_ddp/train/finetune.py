@@ -1,0 +1,56 @@
+"""Fine-tuning: partial checkpoint restore + head swap + freezing.
+
+The capability surface of the reference's vestigial script
+(``/root/reference/ppe_main_ddp.py``): load a pretrained checkpoint with
+``strict=False``, swap the classifier head to a new class count
+(``ppe_main_ddp.py:104-111``), freeze the backbone
+(``ppe_main_ddp.py:116-122`` — broken there by the ``required_grad`` typo;
+working here via optax masking), and train with a second loss (BCE for
+multi-label, ``ppe_main_ddp.py:147``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from tpu_ddp.checkpoint import Checkpointer, merge_params
+from tpu_ddp.train.state import TrainState, create_train_state
+
+
+def load_pretrained_for_finetune(
+    checkpoint_dir: str,
+    model,
+    tx,
+    *,
+    rng=None,
+    step: Optional[int] = None,
+) -> TrainState:
+    """Build a fresh state for `model` (possibly a different head width than
+    the checkpoint), then merge every restored param whose path+shape still
+    matches — the functional ``load_state_dict(strict=False)`` + head-swap.
+
+    The checkpoint's optimizer state is NOT carried over (it belongs to the
+    old parameter set); training restarts at step 0 with fresh opt state,
+    matching the reference's behavior of constructing a new optimizer for
+    fine-tuning (ppe_main_ddp.py:133).
+    """
+    rng = rng if rng is not None else jax.random.key(0)
+    fresh = create_train_state(model, tx, rng)
+    ckpt = Checkpointer(checkpoint_dir)
+    # Restore into a template shaped like the CHECKPOINT, not the new model:
+    # orbax needs matching structure. We restore leniently by reading the
+    # saved tree as-is.
+    import orbax.checkpoint as ocp
+
+    restore_step = ckpt.latest_step() if step is None else step
+    if restore_step is None:
+        raise FileNotFoundError(f"no checkpoint under {checkpoint_dir}")
+    raw = ckpt.manager.restore(restore_step, args=ocp.args.StandardRestore())
+    restored_params = raw["params"] if isinstance(raw, dict) and "params" in raw else raw
+    merged_params = merge_params(restored_params, fresh.params)
+    merged_stats = fresh.batch_stats
+    if isinstance(raw, dict) and "batch_stats" in raw:
+        merged_stats = merge_params(raw["batch_stats"], fresh.batch_stats)
+    return fresh.replace(params=merged_params, batch_stats=merged_stats)

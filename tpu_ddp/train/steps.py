@@ -53,11 +53,14 @@ def make_train_step(
     data_axis: str = DATA_AXIS,
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
+    compute_accuracy: bool = True,
 ) -> Callable[[TrainState, Batch], tuple]:
     """Build the compiled DDP train step for `mesh`.
 
     Returns step(state, batch) -> (state, metrics) where batch is a global
     {image, label, mask} dict sharded on its leading axis over `data_axis`.
+    ``compute_accuracy=False`` for losses whose labels aren't class indices
+    (e.g. multi-hot BCE targets).
     """
 
     def compute_loss(params, batch_stats, batch):
@@ -84,10 +87,6 @@ def make_train_step(
             state.params, state.batch_stats, batch
         )
         new_stats = jax.tree.map(lambda s: lax.pmean(s, data_axis), new_stats)
-        correct, count = masked_accuracy(logits, batch["label"], batch.get("mask"))
-        correct = lax.psum(correct, data_axis)
-        count = lax.psum(count, data_axis)
-
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
@@ -96,10 +95,14 @@ def make_train_step(
             batch_stats=new_stats,
             opt_state=new_opt_state,
         )
-        metrics = {
-            "loss": loss,
-            "accuracy": correct / jnp.maximum(count, 1.0),
-        }
+        metrics = {"loss": loss}
+        if compute_accuracy:
+            correct, count = masked_accuracy(
+                logits, batch["label"], batch.get("mask")
+            )
+            metrics["accuracy"] = lax.psum(correct, data_axis) / jnp.maximum(
+                lax.psum(count, data_axis), 1.0
+            )
         return new_state, metrics
 
     sharded = jax.shard_map(
@@ -117,6 +120,7 @@ def make_eval_step(
     *,
     data_axis: str = DATA_AXIS,
     loss_fn: Callable = cross_entropy_loss,
+    compute_accuracy: bool = True,
 ) -> Callable[[TrainState, Batch], dict]:
     """Compiled eval step: running-stats BN, summed correct/count/loss over
     the mesh. The eval loop the reference's runnable path never had
@@ -127,7 +131,15 @@ def make_eval_step(
         logits = model.apply(variables, batch["image"], train=False)
         mask = batch.get("mask")
         loss = loss_fn(logits, batch["label"], mask)
-        correct, count = masked_accuracy(logits, batch["label"], mask)
+        if compute_accuracy:
+            correct, count = masked_accuracy(logits, batch["label"], mask)
+        else:
+            correct = jnp.zeros(())
+            count = (
+                mask.astype(jnp.float32).sum()
+                if mask is not None
+                else jnp.asarray(float(logits.shape[0]))
+            )
         return {
             "correct": lax.psum(correct, data_axis),
             "count": lax.psum(count, data_axis),
@@ -141,6 +153,30 @@ def make_eval_step(
         mesh=mesh,
         in_specs=(P(), P(data_axis)),
         out_specs=P(),
+    )
+    return jax.jit(sharded)
+
+
+def make_predict_step(
+    model,
+    mesh: Mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+):
+    """Compiled batch-inference step: sharded forward, logits returned in the
+    batch's global order. Covers the reference's batch-inference capability
+    (``ppe_main_ddp.py:310-396`` runs a loaded model over a test loader and
+    dumps predictions)."""
+
+    def shard_predict(state: TrainState, batch: Batch):
+        variables = {"params": state.params, "batch_stats": state.batch_stats}
+        return model.apply(variables, batch["image"], train=False)
+
+    sharded = jax.shard_map(
+        shard_predict,
+        mesh=mesh,
+        in_specs=(P(), P(data_axis)),
+        out_specs=P(data_axis),
     )
     return jax.jit(sharded)
 

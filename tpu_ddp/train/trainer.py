@@ -59,6 +59,12 @@ class TrainConfig:
     resume: bool = False
     jsonl_path: Optional[str] = None
     freeze_prefixes: Optional[tuple] = None  # e.g. ("fc",) trains head only
+    loss: str = "ce"                      # "ce" | "bce" (multi-label,
+                                          # ppe_main_ddp.py:147)
+    pretrained_dir: Optional[str] = None  # fine-tune: partial restore +
+                                          # head swap (ppe_main_ddp.py:104-111)
+    plot_curves: Optional[str] = None     # PNG path (ppe_main_ddp.py:176-181)
+    dump_predictions: Optional[str] = None  # JSON path (ppe_main_ddp.py:310-396)
 
 
 def build_model(config: TrainConfig):
@@ -81,7 +87,9 @@ def build_model(config: TrainConfig):
 
 
 class Trainer:
-    def __init__(self, config: TrainConfig):
+    def __init__(self, config: TrainConfig, *, train_data=None, test_data=None):
+        """train_data/test_data: optional (images, labels) tuples that bypass
+        the dataset loader — used by the k-fold driver and tests."""
         self.config = config
         devices = jax.devices()
         if config.n_devices:
@@ -91,7 +99,7 @@ class Trainer:
         self.batch_sharding = batch_sharding(self.mesh)
 
         self.model = build_model(config)
-        self._load_data()
+        self._load_data(train_data, test_data)
         total_steps = self.train_loader.steps_per_epoch * config.epochs
         freeze = None
         if config.freeze_prefixes:
@@ -107,11 +115,43 @@ class Trainer:
             warmup_steps=config.warmup_steps,
             freeze_predicate=freeze,
         )
-        self.state = create_train_state(
-            self.model, self.tx, jax.random.key(config.seed)
+        if config.pretrained_dir:
+            from tpu_ddp.parallel.mesh import replicated_sharding
+            from tpu_ddp.train.finetune import load_pretrained_for_finetune
+
+            self.state = jax.device_put(
+                load_pretrained_for_finetune(
+                    config.pretrained_dir,
+                    self.model,
+                    self.tx,
+                    rng=jax.random.key(config.seed),
+                ),
+                replicated_sharding(self.mesh),
+            )
+        else:
+            self.state = create_train_state(
+                self.model, self.tx, jax.random.key(config.seed)
+            )
+        from tpu_ddp.train.losses import (
+            binary_cross_entropy_with_logits,
+            cross_entropy_loss,
         )
-        self.train_step = make_train_step(self.model, self.tx, self.mesh)
-        self.eval_step = make_eval_step(self.model, self.mesh)
+
+        if config.loss == "ce":
+            loss_fn, with_acc = cross_entropy_loss, True
+        elif config.loss == "bce":
+            loss_fn, with_acc = binary_cross_entropy_with_logits, False
+        else:
+            raise ValueError(f"unknown loss {config.loss!r}")
+        self.train_step = make_train_step(
+            self.model, self.tx, self.mesh,
+            loss_fn=loss_fn, compute_accuracy=with_acc,
+        )
+        self.eval_step = make_eval_step(
+            self.model, self.mesh, loss_fn=loss_fn, compute_accuracy=with_acc
+        )
+        self.predict_step = None  # built lazily in predict()
+        self.history: dict = {"epoch": [], "train_loss": []}
         self.logger = MetricLogger(jsonl_path=config.jsonl_path)
 
         self.checkpointer = None
@@ -132,13 +172,17 @@ class Trainer:
                     f"resumed from step {int(self.state.step)}"
                 )
 
-    def _load_data(self):
+    def _load_data(self, train_data=None, test_data=None):
         c = self.config
-        if c.synthetic_data:
-            from tpu_ddp.data.cifar10 import synthetic_cifar10
+        if train_data is not None:
+            train = train_data
+            test = test_data if test_data is not None else train_data
+        elif c.synthetic_data:
+            from tpu_ddp.data.cifar10 import synthetic_cifar10, synthetic_multilabel
 
-            train = synthetic_cifar10(c.synthetic_size, c.num_classes, c.seed)
-            test = synthetic_cifar10(max(c.synthetic_size // 5, 64), c.num_classes, c.seed + 1)
+            gen = synthetic_multilabel if c.loss == "bce" else synthetic_cifar10
+            train = gen(c.synthetic_size, c.num_classes, c.seed)
+            test = gen(max(c.synthetic_size // 5, 64), c.num_classes, c.seed + 1)
         else:
             from tpu_ddp.data.cifar10 import load_cifar10
 
@@ -152,11 +196,18 @@ class Trainer:
             reshuffle_each_epoch=c.reshuffle_each_epoch,
             seed=c.seed,
         )
+        if c.loss == "bce" and np.asarray(train[1]).ndim != 2:
+            raise ValueError(
+                "--loss bce needs multi-hot (N, C) targets; this dataset "
+                "yields class indices. Use --synthetic-data (multi-label "
+                "generator) or pass multi-hot train_data."
+            )
         self.test_loader = ShardedBatchLoader(
             *test,
             world_size=self.world_size,
             per_shard_batch=c.per_shard_batch,
             shuffle=False,
+            exclude_sampler_pad=True,  # metrics count each sample once
         )
 
     def _put(self, batch):
@@ -182,30 +233,54 @@ class Trainer:
                 throughput.add(int(batch["mask"].sum()))
                 loss_sum += float(epoch_metrics["loss"])
                 n_batches += 1
+            mean_loss = loss_sum / max(n_batches, 1)
+            self.history["epoch"].append(epoch)
+            self.history["train_loss"].append(mean_loss)
             if epoch == 1 or epoch % c.log_every_epochs == 0:
-                mean_loss = loss_sum / max(n_batches, 1)
                 # reference log line shape: main.py:43-44
                 self.logger.log_text(
                     f"Epoch {epoch}, Training loss {mean_loss}"
+                )
+                extra = (
+                    {"train_accuracy": float(epoch_metrics["accuracy"])}
+                    if "accuracy" in epoch_metrics
+                    else {}
                 )
                 self.logger.log(
                     int(self.state.step),
                     epoch=epoch,
                     train_loss=mean_loss,
-                    train_accuracy=float(epoch_metrics["accuracy"]),
+                    **extra,
                 )
                 if self.checkpointer and epoch % c.checkpoint_every_epochs in (0, 1):
                     self.checkpointer.save(int(self.state.step), self.state)
             if c.eval_each_epoch:
                 acc, loss = self.evaluate()
-                self.logger.log(int(self.state.step), test_accuracy=acc, test_loss=loss)
-                last_metrics["test_accuracy"] = acc
+                self.history.setdefault("test_loss", []).append(loss)
+                if c.loss == "ce":  # accuracy undefined for multi-hot targets
+                    self.logger.log(
+                        int(self.state.step), test_accuracy=acc, test_loss=loss
+                    )
+                    self.history.setdefault("test_accuracy", []).append(acc)
+                    last_metrics["test_accuracy"] = acc
+                else:
+                    self.logger.log(int(self.state.step), test_loss=loss)
         throughput.stop(wait_for=self.state.params)
         total = time.time() - start
         # reference wall-clock line: main.py:49
         self.logger.log_text(f"training time: {total:.3f} seconds")
         if self.checkpointer:
             self.checkpointer.save(int(self.state.step), self.state, wait=True)
+        from tpu_ddp.parallel.runtime import is_primary_process
+
+        if c.plot_curves and is_primary_process():
+            from tpu_ddp.metrics.plotting import plot_loss_curves
+
+            series = {"train_loss": self.history["train_loss"]}
+            if self.history.get("test_loss"):
+                series["test_loss"] = self.history["test_loss"]
+            plot_loss_curves(series, c.plot_curves)
+            self.logger.log_text(f"loss curves -> {c.plot_curves}")
         last_metrics.update(
             total_seconds=total,
             mean_step_seconds=timer.mean_step_seconds,
@@ -223,3 +298,22 @@ class Trainer:
             count += float(out["count"])
             loss_sum += float(out["loss_sum"])
         return correct / max(count, 1.0), loss_sum / max(count, 1.0)
+
+    def predict(self, loader=None):
+        """Batch inference over a loader: (logits, labels) as host numpy
+        arrays with sampler/batch padding removed — the reference's
+        inference + prediction-dump capability (ppe_main_ddp.py:310-396)."""
+        import numpy as np
+
+        from tpu_ddp.train.steps import make_predict_step
+
+        if self.predict_step is None:
+            self.predict_step = make_predict_step(self.model, self.mesh)
+        loader = loader if loader is not None else self.test_loader
+        logits_all, labels_all = [], []
+        for batch in loader.epoch_batches(epoch=0):
+            logits = np.asarray(self.predict_step(self.state, self._put(batch)))
+            mask = batch["mask"]
+            logits_all.append(logits[mask])
+            labels_all.append(np.asarray(batch["label"])[mask])
+        return np.concatenate(logits_all), np.concatenate(labels_all)
