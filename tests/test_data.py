@@ -120,3 +120,39 @@ def test_exclude_sampler_pad_mask():
     for b in loader:
         seen.extend(np.asarray(b["label"])[b["mask"]].tolist())
     assert sorted(seen) == sorted(labels.tolist())
+
+
+def test_cifar10_loader_from_fake_pickles(tmp_path):
+    """End-to-end pickle loading path with a synthetic on-disk dataset
+    (covers _find_dataset_dir + _load_pickles for both datasets)."""
+    import pickle
+
+    d10 = tmp_path / "cifar-10-batches-py"
+    d10.mkdir()
+    rng = np.random.default_rng(0)
+    for name, n in [("data_batch_1", 20), ("test_batch", 10)]:
+        with open(d10 / name, "wb") as f:
+            pickle.dump(
+                {b"data": rng.integers(0, 255, (n, 3072), dtype=np.uint8),
+                 b"labels": rng.integers(0, 10, n).tolist()}, f)
+    # only batch_1 present: patch module constant to load a single batch
+    from tpu_ddp.data import cifar10 as c10
+
+    old = c10._TRAIN_FILES
+    c10._TRAIN_FILES = ["data_batch_1"]
+    try:
+        imgs, labels = c10.load_cifar10(str(tmp_path), train=True)
+    finally:
+        c10._TRAIN_FILES = old
+    assert imgs.shape == (20, 32, 32, 3) and imgs.dtype == np.float32
+    assert labels.shape == (20,)
+
+    d100 = tmp_path / "c100" / "cifar-100-python"
+    d100.mkdir(parents=True)
+    with open(d100 / "test", "wb") as f:
+        pickle.dump(
+            {b"data": rng.integers(0, 255, (8, 3072), dtype=np.uint8),
+             b"fine_labels": rng.integers(0, 100, 8).tolist()}, f)
+    imgs, labels = c10.load_cifar100(str(tmp_path / "c100"), train=False)
+    assert imgs.shape == (8, 32, 32, 3)
+    assert labels.max() < 100

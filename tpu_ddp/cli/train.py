@@ -19,6 +19,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cpu forces the XLA CPU backend; tpu/auto use the "
                         "platform JAX selected (BASELINE.json north star flag)")
     p.add_argument("--data-dir", default="data/CIFAR-10")
+    p.add_argument("--dataset", choices=["cifar10", "cifar100"], default="cifar10",
+                   help="cifar100 = BASELINE.json configs[2] scale-out recipe "
+                        "(set --num-classes 100)")
     p.add_argument("--synthetic-data", action="store_true",
                    help="class-conditional synthetic CIFAR (no dataset needed)")
     p.add_argument("--epochs", type=int, default=99)
@@ -39,7 +42,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--untied-blocks", action="store_true",
                    help="independent ResBlocks (the reference's list-repeat "
                         "quirk ties them; see SURVEY.md §2.2)")
-    p.add_argument("--num-classes", type=int, default=10)
+    p.add_argument("--num-classes", type=int, default=None,
+                   help="default: derived from --dataset (cifar10=10, "
+                        "cifar100=100)")
     p.add_argument("--sync-bn", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-shuffle", action="store_true")
@@ -85,6 +90,7 @@ def config_from_args(args) -> TrainConfig:
         per_shard = args.global_batch_size // world
     return TrainConfig(
         data_dir=args.data_dir,
+        dataset=args.dataset,
         synthetic_data=args.synthetic_data,
         epochs=args.epochs,
         per_shard_batch=per_shard,
@@ -100,7 +106,11 @@ def config_from_args(args) -> TrainConfig:
         sync_bn=args.sync_bn,
         model=args.model,
         tied_blocks=not args.untied_blocks,
-        num_classes=args.num_classes,
+        num_classes=(
+            args.num_classes
+            if args.num_classes is not None
+            else {"cifar10": 10, "cifar100": 100}[args.dataset]
+        ),
         log_every_epochs=args.log_every_epochs,
         eval_each_epoch=args.eval_each_epoch,
         checkpoint_dir=args.checkpoint_dir,

@@ -24,40 +24,80 @@ CIFAR10_STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
 
 _TRAIN_FILES = [f"data_batch_{i}" for i in range(1, 6)]
 _TEST_FILES = ["test_batch"]
+# CIFAR-100 raw layout (python pickle, 'fine_labels' key)
+_C100_TRAIN_FILES = ["train"]
+_C100_TEST_FILES = ["test"]
 
 
-def _find_batches_dir(data_dir: str) -> str:
+def _find_dataset_dir(
+    data_dir: str, subdir: str, marker_files, tarball: str, what: str
+) -> str:
+    """Locate an extracted dataset dir (any marker file present), or
+    auto-extract a downloaded tarball (torchvision leaves one)."""
     candidates = [
         data_dir,
-        os.path.join(data_dir, "cifar-10-batches-py"),
-        os.path.join(data_dir, "CIFAR-10", "cifar-10-batches-py"),
+        os.path.join(data_dir, subdir),
+        os.path.join(data_dir, what, subdir),
     ]
     for c in candidates:
-        if os.path.isfile(os.path.join(c, "data_batch_1")):
+        if any(os.path.isfile(os.path.join(c, m)) for m in marker_files):
             return c
-    # Auto-extract a downloaded tarball if present (torchvision leaves one).
-    for c in [data_dir, os.path.join(data_dir, "CIFAR-10")]:
-        tar = os.path.join(c, "cifar-10-python.tar.gz")
+    for c in [data_dir, os.path.join(data_dir, what)]:
+        tar = os.path.join(c, tarball)
         if os.path.isfile(tar):
             with tarfile.open(tar) as tf:
                 tf.extractall(c)
-            return os.path.join(c, "cifar-10-batches-py")
+            return os.path.join(c, subdir)
     raise FileNotFoundError(
-        f"CIFAR-10 batches not found under {data_dir!r} (download=False "
-        "semantics, main.py:53). Expected cifar-10-batches-py/data_batch_* "
-        "or cifar-10-python.tar.gz. Use synthetic_cifar10() for smoke runs."
+        f"{what} batches not found under {data_dir!r} (download=False "
+        f"semantics, main.py:53). Expected {subdir}/{marker_files[0]} "
+        f"or {tarball}. Use synthetic_cifar10() for smoke runs."
+    )
+
+
+def _find_batches_dir(data_dir: str) -> str:
+    return _find_dataset_dir(
+        data_dir,
+        "cifar-10-batches-py",
+        ["data_batch_1", "test_batch"],
+        "cifar-10-python.tar.gz",
+        "CIFAR-10",
     )
 
 
 def load_cifar10(data_dir: str, train: bool = True) -> Tuple[np.ndarray, np.ndarray]:
     """Return (images float32 NHWC normalized, labels int32)."""
     batches_dir = _find_batches_dir(data_dir)
+    return _load_pickles(
+        batches_dir, _TRAIN_FILES if train else _TEST_FILES, b"labels"
+    )
+
+
+def load_cifar100(data_dir: str, train: bool = True) -> Tuple[np.ndarray, np.ndarray]:
+    """CIFAR-100 (fine labels, 100 classes) from the raw python pickles —
+    the scale-out dataset of BASELINE.json configs[2]. Same image layout and
+    normalization constants as CIFAR-10 (close enough for training; swap via
+    normalize() if exact per-dataset stats are wanted)."""
+    batches_dir = _find_dataset_dir(
+        data_dir,
+        "cifar-100-python",
+        ["train", "test"],
+        "cifar-100-python.tar.gz",
+        "CIFAR-100",
+    )
+    return _load_pickles(
+        batches_dir, _C100_TRAIN_FILES if train else _C100_TEST_FILES,
+        b"fine_labels",
+    )
+
+
+def _load_pickles(batches_dir, files, label_key):
     imgs, labels = [], []
-    for name in _TRAIN_FILES if train else _TEST_FILES:
+    for name in files:
         with open(os.path.join(batches_dir, name), "rb") as f:
             d = pickle.load(f, encoding="bytes")
         imgs.append(d[b"data"])
-        labels.extend(d[b"labels"])
+        labels.extend(d[label_key])
     raw = np.concatenate(imgs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
     return normalize(raw), np.asarray(labels, np.int32)
 

@@ -1,5 +1,8 @@
 """Model zoo (L2). Flax re-expressions of the reference's model layer."""
 
 from tpu_ddp.models.resnet import NetResDeep, ResBlock
+from tpu_ddp.models.zoo import MODEL_REGISTRY
+import tpu_ddp.models.resnet_family  # noqa: F401  (registers resnet18..152)
+import tpu_ddp.models.vit  # noqa: F401  (registers vit_s4, vit_b16)
 
-__all__ = ["NetResDeep", "ResBlock"]
+__all__ = ["NetResDeep", "ResBlock", "MODEL_REGISTRY"]

@@ -1,0 +1,85 @@
+"""Ring attention: sequence-parallel self-attention over a mesh axis.
+
+First-class long-context support (build brief; the reference has no
+attention or sequence dimension at all — SURVEY.md §5.7 documents the
+absence). Each device holds a sequence shard of Q/K/V; K/V blocks rotate
+around the ring via ``lax.ppermute`` (neighbor exchange over ICI) while a
+numerically-stable online softmax (flash-attention style running max /
+denominator) accumulates the output. Peak memory per device is O(T_local^2)
+instead of O(T^2), and the K/V transfer overlaps with the current block's
+compute under XLA's latency-hiding scheduler.
+
+Usage: inside ``jax.shard_map`` with the sequence dimension sharded over
+``axis_name`` — e.g. bind it as a ViT's ``attention_impl``:
+
+    attn = functools.partial(ring_attention, axis_name="sequence")
+    model = ViT(attention_impl=attn)
+
+Semantics: NON-causal (bidirectional) attention, exact (not approximate) —
+output equals full attention up to float reassociation; pinned by
+tests/test_ring_attention.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block(q, k, v, scale):
+    """One (q-block, k-block) attention tile with raw (unnormalized)
+    accumulators: returns o = exp(s - m) @ v, running max m, denom l."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # (B,H,Tq,Tk)
+    m = s.max(axis=-1)  # (B,H,Tq)
+    p = jnp.exp(s - m[..., None])
+    l = p.sum(axis=-1)  # (B,H,Tq)
+    o = jnp.einsum("bhqk,bkhd->bhqd", p, v)  # (B,H,Tq,D)
+    return o, m, l
+
+
+def ring_attention(q, k, v, *, axis_name: str):
+    """q,k,v: (B, T_local, H, D) sequence-sharded over `axis_name`.
+    Returns (B, T_local, H, D) — this device's shard of exact full
+    attention over the global sequence."""
+    n = lax.axis_size(axis_name)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+
+    o, m, l = _block(q, k, v, scale)
+    # Rotate K/V n-1 times; n is static (mesh shape), so a Python loop
+    # unrolls into a fixed chain of ppermute + fused attention tiles that
+    # XLA can pipeline (collective-permute overlapped with the next tile).
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for _ in range(n - 1):
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        o2, m2, l2 = _block(q, k, v, scale)
+        m_new = jnp.maximum(m, m2)
+        a1 = jnp.exp(m - m_new)
+        a2 = jnp.exp(m2 - m_new)
+        o = o * a1[..., None] + o2 * a2[..., None]
+        l = l * a1 + l2 * a2
+        m = m_new
+    out = o / l[..., None]  # (B,H,Tq,D)
+    return out.transpose(0, 2, 1, 3)  # -> (B, Tq, H, D)
+
+
+def sequence_sharded_attention(mesh, axis_name: str = "sequence"):
+    """Convenience: shard_map-wrapped ring attention for (B, T, H, D) global
+    arrays with T sharded over `axis_name`. Mostly for tests/demos — inside
+    a full SP model you call ring_attention directly from the model's
+    shard_map context."""
+    from jax.sharding import PartitionSpec as P
+
+    import functools
+
+    fn = functools.partial(ring_attention, axis_name=axis_name)
+    spec = P(None, axis_name)  # shard T (dim 1)
+    return jax.jit(
+        jax.shard_map(
+            lambda q, k, v: fn(q, k, v),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+    )

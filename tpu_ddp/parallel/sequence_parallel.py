@@ -1,0 +1,81 @@
+"""Sequence-parallel (SP) training: data x sequence 2-D mesh.
+
+First-class long-context training (build brief; absent from the reference —
+SURVEY.md §5.7). The train step runs under ``jax.shard_map`` over BOTH mesh
+axes: the batch dim is sharded over ``data`` and the image height (hence the
+patch/token sequence) over ``sequence``. Inside, the SP-aware ViT
+(``tpu_ddp.models.vit.ViT(sp_axis=...)``) does ring attention over the
+sequence ring while gradient sync happens exactly like the DDP step: the
+loss is pmean'd over both axes before AD, so the transpose + the
+unvarying-params psum produce globally averaged gradients with XLA free to
+overlap both collectives with compute.
+
+Memory: each device holds T/n_seq tokens -> attention working set drops from
+O(T^2) to O(T * T/n_seq), which is what makes long sequences fit at all.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_ddp.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
+from tpu_ddp.train.losses import cross_entropy_loss
+from tpu_ddp.train.state import TrainState
+
+
+def make_sp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+    seq_axis: str = SEQUENCE_AXIS,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+):
+    """Compiled train step for an SP-aware model (ViT with sp_axis=seq_axis).
+
+    Batch layout: {image (N, H, W, C), label (N,), mask (N,)} — image sharded
+    (data, sequence) on (N, H); labels/mask sharded on data only. H must be
+    divisible by patch_size * mesh.shape[seq_axis].
+    """
+
+    def compute_loss(params, batch):
+        logits = model.apply({"params": params}, batch["image"], train=True)
+        loss = loss_fn(logits, batch["label"], batch.get("mask"))
+        # Gradient sync (see tpu_ddp.train.steps on why the pmean precedes
+        # AD). Over `data_axis` ONLY: the SP model's mean-pool pmean already
+        # made the loss invariant over `seq_axis`, and shard_map's
+        # varying-axes tracking inserts the correct sequence-axis psums for
+        # the distributed attention partials during the transpose.
+        return lax.pmean(loss, data_axis)
+
+    def shard_step(state: TrainState, batch):
+        loss, grads = jax.value_and_grad(compute_loss)(state.params, batch)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            state.replace(
+                step=state.step + 1, params=new_params, opt_state=new_opt_state
+            ),
+            {"loss": loss},
+        )
+
+    batch_specs = {
+        "image": P(data_axis, seq_axis),
+        "label": P(data_axis),
+        "mask": P(data_axis),
+    }
+    sharded = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(P(), batch_specs),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())

@@ -35,6 +35,7 @@ class TrainConfig:
     script's argparse surface (SURVEY.md §5.6), as one dataclass."""
 
     data_dir: str = "data/CIFAR-10"      # main.py:19
+    dataset: str = "cifar10"              # cifar10 | cifar100
     synthetic_data: bool = False          # no torchvision download path
     synthetic_size: int = 2048
     epochs: int = 99                      # range(1,100), main.py:30
@@ -184,10 +185,11 @@ class Trainer:
             train = gen(c.synthetic_size, c.num_classes, c.seed)
             test = gen(max(c.synthetic_size // 5, 64), c.num_classes, c.seed + 1)
         else:
-            from tpu_ddp.data.cifar10 import load_cifar10
+            from tpu_ddp.data.cifar10 import load_cifar10, load_cifar100
 
-            train = load_cifar10(c.data_dir, train=True)
-            test = load_cifar10(c.data_dir, train=False)
+            load = {"cifar10": load_cifar10, "cifar100": load_cifar100}[c.dataset]
+            train = load(c.data_dir, train=True)
+            test = load(c.data_dir, train=False)
         self.train_loader = ShardedBatchLoader(
             *train,
             world_size=self.world_size,
