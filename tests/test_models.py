@@ -91,3 +91,42 @@ def test_num_classes_head_swap():
     model = NetResDeep(num_classes=3)
     variables, x = _init(model)
     assert model.apply(variables, x, train=False).shape == (2, 3)
+
+
+def test_bf16_compute_dtype():
+    """bf16 compute: f32 params, finite f32 logits, train step runs."""
+    model = NetResDeep(n_blocks=2, dtype=jnp.bfloat16)
+    variables, x = _init(model, batch=4)
+    assert variables["params"]["conv1"]["kernel"].dtype == jnp.float32
+    logits = model.apply(variables, x, train=False)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_remat_step_matches_plain():
+    """jax.checkpoint must not change the math."""
+    import numpy as np
+
+    from tpu_ddp.data import synthetic_cifar10
+    from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
+    from tpu_ddp.train import create_train_state, make_optimizer, make_train_step
+
+    mesh = create_mesh(MeshSpec(data=-1), jax.devices()[:2])
+    model = NetResDeep(n_blocks=2)
+    tx = make_optimizer(lr=0.05)
+    imgs, labels = synthetic_cifar10(16, seed=9)
+    batch = jax.device_put(
+        {"image": imgs, "label": labels, "mask": np.ones(16, bool)},
+        batch_sharding(mesh),
+    )
+    outs = {}
+    for remat in (False, True):
+        state = create_train_state(model, tx, jax.random.key(0))
+        step = make_train_step(model, tx, mesh, donate=False, remat=remat)
+        state, metrics = step(state, batch)
+        outs[remat] = (float(metrics["loss"]), state)
+    assert abs(outs[False][0] - outs[True][0]) < 1e-6
+    for a, b in zip(
+        jax.tree.leaves(outs[False][1].params), jax.tree.leaves(outs[True][1].params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

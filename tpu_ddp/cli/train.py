@@ -46,6 +46,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="default: derived from --dataset (cifar10=10, "
                         "cifar100=100)")
     p.add_argument("--sync-bn", action="store_true")
+    p.add_argument("--compute-dtype", choices=["float32", "bfloat16"],
+                   default="float32",
+                   help="bfloat16 runs the forward/backward on the MXU at "
+                        "2x throughput; params/loss stay f32")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize the forward in backward "
+                        "(jax.checkpoint): fits deeper models in HBM")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-shuffle", action="store_true")
     p.add_argument("--faithful-epoch-order", action="store_true",
@@ -104,6 +111,8 @@ def config_from_args(args) -> TrainConfig:
         shuffle=not args.no_shuffle,
         reshuffle_each_epoch=not args.faithful_epoch_order,
         sync_bn=args.sync_bn,
+        compute_dtype=args.compute_dtype,
+        remat=args.remat,
         model=args.model,
         tied_blocks=not args.untied_blocks,
         num_classes=(

@@ -98,8 +98,13 @@ def _load_pickles(batches_dir, files, label_key):
             d = pickle.load(f, encoding="bytes")
         imgs.append(d[b"data"])
         labels.extend(d[label_key])
-    raw = np.concatenate(imgs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
-    return normalize(raw), np.asarray(labels, np.int32)
+    raw = np.concatenate(imgs)  # (N, 3072) planar RGB
+    from tpu_ddp import native
+
+    return (
+        native.decode_normalize(raw, CIFAR10_MEAN, CIFAR10_STD),
+        np.asarray(labels, np.int32),
+    )
 
 
 def normalize(images_uint8: np.ndarray) -> np.ndarray:

@@ -31,6 +31,14 @@ from typing import Dict, Iterator, Optional
 import numpy as np
 
 
+def _gather(arr: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """Batch row-gather via the native multithreaded library when available
+    (tpu_ddp.native), else numpy fancy indexing."""
+    from tpu_ddp import native
+
+    return native.gather_rows(arr, idx)
+
+
 def shard_indices(
     n: int,
     world_size: int,
@@ -137,8 +145,8 @@ class ShardedBatchLoader:
             if self.exclude_sampler_pad:
                 mask[:, :valid] &= real
             yield {
-                "image": self.images[idx],
-                "label": self.labels[idx],
+                "image": _gather(self.images, idx),
+                "label": _gather(self.labels, idx),
                 "mask": mask.reshape(-1),
             }
 

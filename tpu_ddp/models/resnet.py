@@ -41,10 +41,14 @@ class ResBlock(nn.Module):
 
     Mirrors ``/root/reference/model/resnet.py:24-37`` including its init:
     kaiming-normal(relu) conv kernel, BN scale=0.5, BN bias=0.
+
+    ``dtype`` is the COMPUTE dtype (bfloat16 feeds the MXU at 2x f32
+    throughput); params are stored f32 regardless (flax param_dtype default).
     """
 
     n_chans: int
     bn_cross_replica_axis: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -54,6 +58,7 @@ class ResBlock(nn.Module):
             padding=1,
             use_bias=False,
             kernel_init=kaiming_normal_relu,
+            dtype=self.dtype,
             name="conv",
         )(x)
         out = nn.BatchNorm(
@@ -63,6 +68,7 @@ class ResBlock(nn.Module):
             scale_init=constant(0.5),
             bias_init=constant(0.0),
             axis_name=self.bn_cross_replica_axis,
+            dtype=self.dtype,
             name="batch_norm",
         )(out)
         out = nn.relu(out)
@@ -83,6 +89,7 @@ class NetResDeep(nn.Module):
     num_classes: int = 10
     tied: bool = True
     bn_cross_replica_axis: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -93,6 +100,7 @@ class NetResDeep(nn.Module):
             padding=1,
             kernel_init=torch_default_kernel,
             bias_init=make_torch_default_bias(3 * 3 * 3),
+            dtype=self.dtype,
             name="conv1",
         )(x)
         out = nn.max_pool(nn.relu(out), (2, 2), strides=(2, 2))  # 32x32 -> 16x16
@@ -105,6 +113,7 @@ class NetResDeep(nn.Module):
             block = ResBlock(
                 n_chans=self.n_chans1,
                 bn_cross_replica_axis=self.bn_cross_replica_axis,
+                dtype=self.dtype,
                 name="resblock",
             )
             for _ in range(self.n_blocks):
@@ -114,6 +123,7 @@ class NetResDeep(nn.Module):
                 out = ResBlock(
                     n_chans=self.n_chans1,
                     bn_cross_replica_axis=self.bn_cross_replica_axis,
+                    dtype=self.dtype,
                     name=f"resblock_{i}",
                 )(out, train=train)
 
@@ -123,6 +133,7 @@ class NetResDeep(nn.Module):
             32,
             kernel_init=torch_default_kernel,
             bias_init=make_torch_default_bias(8 * 8 * self.n_chans1),
+            dtype=self.dtype,
             name="fc1",
         )(out)
         out = nn.relu(out)
@@ -130,6 +141,8 @@ class NetResDeep(nn.Module):
             self.num_classes,
             kernel_init=torch_default_kernel,
             bias_init=make_torch_default_bias(32),
+            dtype=self.dtype,
             name="fc2",
         )(out)
-        return out  # logits; softmax lives in the loss (main.py:28 semantics)
+        # logits upcast to f32 so the loss/softmax runs full precision
+        return out.astype(jnp.float32)  # softmax lives in the loss (main.py:28)

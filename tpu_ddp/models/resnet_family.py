@@ -8,8 +8,9 @@ residual topology (He et al. 2015) in NHWC with a CIFAR stem (3x3, no
 max-pool) or ImageNet stem (7x7/2 + max-pool 3x3/2).
 
 TPU notes: NHWC convs lower straight onto the MXU; BN+ReLU fuse into the
-conv epilogue under XLA. bfloat16 compute is handled at the train-step level
-(params stay f32; see tpu_ddp.train.steps), not baked into the module.
+conv epilogue under XLA. ``dtype=bfloat16`` runs compute in bf16 on the MXU
+while params stay f32 (flax param_dtype default); logits upcast to f32 for
+the loss.
 """
 
 from __future__ import annotations
@@ -29,6 +30,7 @@ class _BasicBlock(nn.Module):
     filters: int
     strides: int = 1
     bn_cross_replica_axis: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -37,8 +39,10 @@ class _BasicBlock(nn.Module):
             use_running_average=not train,
             momentum=0.9,
             axis_name=self.bn_cross_replica_axis,
+            dtype=self.dtype,
         )
-        conv = partial(nn.Conv, use_bias=False, kernel_init=_he_init)
+        conv = partial(nn.Conv, use_bias=False, kernel_init=_he_init,
+                       dtype=self.dtype)
 
         residual = x
         y = conv(self.filters, (3, 3), strides=(self.strides, self.strides), padding=1)(x)
@@ -57,6 +61,7 @@ class _Bottleneck(nn.Module):
     strides: int = 1
     bn_cross_replica_axis: Optional[str] = None
     expansion: int = 4
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -65,8 +70,10 @@ class _Bottleneck(nn.Module):
             use_running_average=not train,
             momentum=0.9,
             axis_name=self.bn_cross_replica_axis,
+            dtype=self.dtype,
         )
-        conv = partial(nn.Conv, use_bias=False, kernel_init=_he_init)
+        conv = partial(nn.Conv, use_bias=False, kernel_init=_he_init,
+                       dtype=self.dtype)
 
         residual = x
         out_filters = self.filters * self.expansion
@@ -92,6 +99,7 @@ class ResNet(nn.Module):
     num_filters: int = 64
     cifar_stem: bool = True
     bn_cross_replica_axis: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -100,16 +108,18 @@ class ResNet(nn.Module):
             use_running_average=not train,
             momentum=0.9,
             axis_name=self.bn_cross_replica_axis,
+            dtype=self.dtype,
         )
         if self.cifar_stem:
             x = nn.Conv(
                 self.num_filters, (3, 3), padding=1, use_bias=False,
-                kernel_init=_he_init, name="stem_conv",
+                kernel_init=_he_init, dtype=self.dtype, name="stem_conv",
             )(x)
         else:
             x = nn.Conv(
                 self.num_filters, (7, 7), strides=(2, 2), padding=3,
-                use_bias=False, kernel_init=_he_init, name="stem_conv",
+                use_bias=False, kernel_init=_he_init, dtype=self.dtype,
+                name="stem_conv",
             )(x)
         x = nn.relu(norm(name="stem_bn")(x))
         if not self.cifar_stem:
@@ -121,38 +131,45 @@ class ResNet(nn.Module):
                     filters=self.num_filters * 2**stage,
                     strides=2 if (b == 0 and stage > 0) else 1,
                     bn_cross_replica_axis=self.bn_cross_replica_axis,
+                    dtype=self.dtype,
                 )(x, train=train)
 
         x = jnp.mean(x, axis=(1, 2))  # global average pool
-        return nn.Dense(self.num_classes, name="head")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)  # f32 logits for the loss
 
 
 @register("resnet18")
-def resnet18(num_classes: int = 10, bn_cross_replica_axis=None, cifar_stem=True):
+def resnet18(num_classes: int = 10, bn_cross_replica_axis=None, cifar_stem=True, dtype=jnp.float32):
     return ResNet((2, 2, 2, 2), _BasicBlock, num_classes=num_classes,
-                  cifar_stem=cifar_stem, bn_cross_replica_axis=bn_cross_replica_axis)
+                  cifar_stem=cifar_stem, bn_cross_replica_axis=bn_cross_replica_axis,
+                  dtype=dtype)
 
 
 @register("resnet34")
-def resnet34(num_classes: int = 10, bn_cross_replica_axis=None, cifar_stem=True):
+def resnet34(num_classes: int = 10, bn_cross_replica_axis=None, cifar_stem=True, dtype=jnp.float32):
     return ResNet((3, 4, 6, 3), _BasicBlock, num_classes=num_classes,
-                  cifar_stem=cifar_stem, bn_cross_replica_axis=bn_cross_replica_axis)
+                  cifar_stem=cifar_stem, bn_cross_replica_axis=bn_cross_replica_axis,
+                  dtype=dtype)
 
 
 @register("resnet50")
-def resnet50(num_classes: int = 10, bn_cross_replica_axis=None, cifar_stem=True):
+def resnet50(num_classes: int = 10, bn_cross_replica_axis=None, cifar_stem=True, dtype=jnp.float32):
     return ResNet((3, 4, 6, 3), _Bottleneck, num_classes=num_classes,
-                  cifar_stem=cifar_stem, bn_cross_replica_axis=bn_cross_replica_axis)
+                  cifar_stem=cifar_stem, bn_cross_replica_axis=bn_cross_replica_axis,
+                  dtype=dtype)
 
 
 @register("resnet101")
-def resnet101(num_classes: int = 10, bn_cross_replica_axis=None, cifar_stem=True):
+def resnet101(num_classes: int = 10, bn_cross_replica_axis=None, cifar_stem=True, dtype=jnp.float32):
     """The model ppe_main_ddp.py:1 imports but the reference never ships."""
     return ResNet((3, 4, 23, 3), _Bottleneck, num_classes=num_classes,
-                  cifar_stem=cifar_stem, bn_cross_replica_axis=bn_cross_replica_axis)
+                  cifar_stem=cifar_stem, bn_cross_replica_axis=bn_cross_replica_axis,
+                  dtype=dtype)
 
 
 @register("resnet152")
-def resnet152(num_classes: int = 10, bn_cross_replica_axis=None, cifar_stem=True):
+def resnet152(num_classes: int = 10, bn_cross_replica_axis=None, cifar_stem=True, dtype=jnp.float32):
     return ResNet((3, 8, 36, 3), _Bottleneck, num_classes=num_classes,
-                  cifar_stem=cifar_stem, bn_cross_replica_axis=bn_cross_replica_axis)
+                  cifar_stem=cifar_stem, bn_cross_replica_axis=bn_cross_replica_axis,
+                  dtype=dtype)

@@ -23,46 +23,61 @@ from tpu_ddp.models.zoo import register
 
 
 def full_attention(q, k, v):
-    """q,k,v: (B, T, H, D) -> (B, T, H, D). Non-causal softmax attention."""
+    """q,k,v: (B, T, H, D) -> (B, T, H, D). Non-causal softmax attention.
+
+    Scores accumulate and softmax in f32 regardless of compute dtype
+    (standard mixed-precision practice: bf16 logits saturate sharp
+    distributions); the PV matmul also accumulates f32, then casts back.
+    """
     scale = 1.0 / np.sqrt(q.shape[-1])
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-    p = nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    p = nn.softmax(s.astype(jnp.float32), axis=-1)
+    o = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return o.astype(q.dtype)
 
 
 class MultiHeadSelfAttention(nn.Module):
     num_heads: int
     attention_impl: Callable = staticmethod(full_attention)
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         B, T, C = x.shape
         head_dim = C // self.num_heads
-        qkv = nn.Dense(3 * C, name="qkv")(x)
+        qkv = nn.Dense(3 * C, dtype=self.dtype, name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, self.num_heads, head_dim)
         k = k.reshape(B, T, self.num_heads, head_dim)
         v = v.reshape(B, T, self.num_heads, head_dim)
         o = self.attention_impl(q, k, v)
-        return nn.Dense(C, name="proj")(o.reshape(B, T, C))
+        return nn.Dense(C, dtype=self.dtype, name="proj")(o.reshape(B, T, C))
 
 
 class TransformerBlock(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     attention_impl: Callable = staticmethod(full_attention)
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         del train  # no dropout in v0; interface kept uniform with CNNs
-        y = nn.LayerNorm(name="ln1")(x)
+        y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         x = x + MultiHeadSelfAttention(
-            self.num_heads, attention_impl=self.attention_impl, name="attn"
+            self.num_heads, attention_impl=self.attention_impl,
+            dtype=self.dtype, name="attn"
         )(y)
-        y = nn.LayerNorm(name="ln2")(x)
-        h = nn.Dense(x.shape[-1] * self.mlp_ratio, name="mlp_up")(y)
+        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = nn.Dense(x.shape[-1] * self.mlp_ratio, dtype=self.dtype,
+                     name="mlp_up")(y)
         h = nn.gelu(h)
-        x = x + nn.Dense(x.shape[-1], name="mlp_down")(h)
+        x = x + nn.Dense(x.shape[-1], dtype=self.dtype, name="mlp_down")(h)
         return x
 
 
@@ -83,6 +98,7 @@ class ViT(nn.Module):
     mlp_ratio: int = 4
     attention_impl: Callable = staticmethod(full_attention)
     sp_axis: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
     # kept for CLI/model-zoo interface parity with the CNNs; ViT has no BN
     bn_cross_replica_axis: Optional[str] = None
 
@@ -95,6 +111,7 @@ class ViT(nn.Module):
             self.hidden_dim,
             kernel_size=(self.patch_size, self.patch_size),
             strides=(self.patch_size, self.patch_size),
+            dtype=self.dtype,
             name="patch_embed",
         )(x)  # (B, H/p, W/p, C)
         x = x.reshape(B, -1, self.hidden_dim)  # (B, T_local, C)
@@ -126,30 +143,32 @@ class ViT(nn.Module):
             )
             attention_impl = self.attention_impl
 
-        x = x + pos
+        x = x + pos.astype(x.dtype)
         for i in range(self.depth):
             x = TransformerBlock(
                 self.num_heads,
                 mlp_ratio=self.mlp_ratio,
                 attention_impl=attention_impl,
+                dtype=self.dtype,
                 name=f"block_{i}",
             )(x, train=train)
-        x = nn.LayerNorm(name="ln_f")(x)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         x = x.mean(axis=1)  # mean-pool: SP-friendly (a pmean over sequence)
         if self.sp_axis is not None:
             x = lax.pmean(x, self.sp_axis)
-        return nn.Dense(self.num_classes, name="head")(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)  # f32 logits for the loss
 
 
 @register("vit_s4")
-def vit_s4(num_classes: int = 10, bn_cross_replica_axis=None):
+def vit_s4(num_classes: int = 10, bn_cross_replica_axis=None, dtype=jnp.float32):
     """Small ViT for 32x32 inputs (patch 4 -> 64 tokens)."""
     return ViT(patch_size=4, hidden_dim=192, depth=6, num_heads=3,
-               num_classes=num_classes)
+               num_classes=num_classes, dtype=dtype)
 
 
 @register("vit_b16")
-def vit_b16(num_classes: int = 1000, bn_cross_replica_axis=None):
+def vit_b16(num_classes: int = 1000, bn_cross_replica_axis=None, dtype=jnp.float32):
     """ViT-B/16 (224x224 -> 196 tokens) — the BASELINE.json stretch config."""
     return ViT(patch_size=16, hidden_dim=768, depth=12, num_heads=12,
-               num_classes=num_classes)
+               num_classes=num_classes, dtype=dtype)

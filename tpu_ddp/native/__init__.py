@@ -1,0 +1,133 @@
+"""ctypes bindings for the native host data-path library.
+
+Builds ``libcifar_codec.so`` from the in-tree C++ source on first import
+(g++ is part of the toolchain; no pybind11 in this image, so the binding is
+a plain C ABI + ctypes). Every entry point has a numpy fallback — importing
+this package NEVER fails because of a missing/broken toolchain; check
+``AVAILABLE`` to know which path is live.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "cifar_codec.cpp")
+_LIB_NAME = "libcifar_codec.so"
+
+AVAILABLE = False
+_lib = None
+
+
+def _build_and_load():
+    global AVAILABLE, _lib
+    # Prefer a prebuilt .so next to the source; else build into a cache dir.
+    candidates = [
+        os.path.join(os.path.dirname(__file__), _LIB_NAME),
+        os.path.join(tempfile.gettempdir(), "tpu_ddp_native", _LIB_NAME),
+    ]
+    for path in candidates:
+        if os.path.exists(path) and os.path.getmtime(path) >= os.path.getmtime(_SRC):
+            try:
+                _lib = ctypes.CDLL(path)
+                break
+            except OSError:
+                pass
+    if _lib is None:
+        build_dir = os.path.dirname(candidates[1])
+        os.makedirs(build_dir, exist_ok=True)
+        out = candidates[1]
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            "-o", out, _SRC, "-lpthread",
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            _lib = ctypes.CDLL(out)
+        except Exception as e:  # toolchain missing/failed -> numpy fallback
+            log.warning("native cifar_codec build failed (%s); numpy fallback", e)
+            return
+    try:  # a stale/foreign prebuilt .so must degrade to numpy, not raise
+        _lib.cifar_decode_normalize.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        _lib.gather_rows_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64,
+        ]
+        _lib.gather_rows_i32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64,
+        ]
+        _lib.cifar_codec_abi_version.restype = ctypes.c_int
+        if _lib.cifar_codec_abi_version() != 1:
+            raise RuntimeError("cifar_codec ABI version mismatch")
+    except Exception as e:
+        log.warning("native cifar_codec unusable (%s); numpy fallback", e)
+        _lib = None
+        return
+    AVAILABLE = True
+
+
+_build_and_load()
+
+
+def decode_normalize(raw: np.ndarray, mean: np.ndarray, std: np.ndarray) -> np.ndarray:
+    """(N, 3072) uint8 planar-RGB -> (N, 32, 32, 3) float32 normalized."""
+    raw = np.ascontiguousarray(raw, np.uint8)
+    n = raw.shape[0]
+    assert raw.shape[1] == 3072
+    if AVAILABLE:
+        out = np.empty((n, 32, 32, 3), np.float32)
+        mean32 = np.ascontiguousarray(mean, np.float32)
+        std32 = np.ascontiguousarray(std, np.float32)
+        _lib.cifar_decode_normalize(
+            raw.ctypes.data, out.ctypes.data, n, mean32.ctypes.data,
+            std32.ctypes.data,
+        )
+        return out
+    # numpy fallback: same transform as tpu_ddp.data.cifar10.normalize —
+    # reuse it so the formula lives in exactly one place
+    from tpu_ddp.data.cifar10 import normalize
+
+    return normalize(raw.reshape(n, 3, 32, 32).transpose(0, 2, 3, 1))
+
+
+# Below this, the per-call std::thread fan-out costs more than the copy.
+_NATIVE_GATHER_MIN_BYTES = 1 << 20
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """dst[j] = src[idx[j]] along axis 0, multithreaded for large f32/i32
+    gathers; numpy otherwise (small copies, other dtypes, negative/OOB
+    indices — numpy raises/wraps exactly as fancy indexing always did)."""
+    idx64 = np.ascontiguousarray(idx, np.int64)
+    if (
+        AVAILABLE
+        and src.dtype in (np.float32, np.int32)
+        and src.flags.c_contiguous
+        and idx64.size > 0
+        # native path has no bounds/sign handling: numpy covers those
+        and int(idx64.min()) >= 0
+        and int(idx64.max()) < len(src)
+    ):
+        row_elems = int(np.prod(src.shape[1:], dtype=np.int64)) if src.ndim > 1 else 1
+        if idx64.size * row_elems * src.itemsize >= _NATIVE_GATHER_MIN_BYTES:
+            out = np.empty((len(idx64),) + src.shape[1:], src.dtype)
+            fn = (
+                _lib.gather_rows_f32
+                if src.dtype == np.float32
+                else _lib.gather_rows_i32
+            )
+            fn(src.ctypes.data, idx64.ctypes.data, out.ctypes.data,
+               len(idx64), row_elems)
+            return out
+    return src[idx64]

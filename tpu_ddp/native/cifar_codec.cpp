@@ -1,0 +1,96 @@
+// Native host-side data-path kernels for tpu_ddp.
+//
+// The reference's data path rides torchvision's C++ (PIL/libjpeg decode,
+// ATen tensor transforms — SURVEY.md §2.6 lists the native dependency
+// surface). This library is the in-tree native equivalent for the CIFAR
+// workload: the two host-side hot loops — (1) raw uint8 planar-RGB batches
+// -> normalized float32 NHWC, run once per dataset load, and (2) per-batch
+// row gather (the DistributedSampler-style index select feeding every
+// training step) — implemented multithreaded in C++ and exposed through a
+// C ABI for ctypes (no pybind11 in this image).
+//
+// Build: g++ -O3 -march=native -shared -fPIC -o libcifar_codec.so cifar_codec.cpp -lpthread
+// (tpu_ddp.native builds this lazily at import; see __init__.py)
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Spread [0, n) across up to `max_threads` workers.
+template <typename F>
+void parallel_for(int64_t n, F&& fn) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t n_threads = hw ? static_cast<int64_t>(hw) : 4;
+  if (n_threads > n) n_threads = n > 0 ? n : 1;
+  if (n_threads <= 1) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(n_threads);
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int64_t t = 0; t < n_threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    workers.emplace_back([=, &fn] { fn(lo, hi); });
+  }
+  for (auto& w : workers) w.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// src: n records of 3072 bytes, planar RGB (R 1024, G 1024, B 1024),
+// row-major 32x32 — the raw CIFAR pickle layout.
+// dst: n * 32 * 32 * 3 floats, NHWC, value = (byte/255 - mean[c]) / std[c].
+void cifar_decode_normalize(const uint8_t* src, float* dst, int64_t n,
+                            const float* mean, const float* stddev) {
+  float scale[3], shift[3];
+  for (int c = 0; c < 3; ++c) {
+    scale[c] = 1.0f / (255.0f * stddev[c]);
+    shift[c] = mean[c] / stddev[c];
+  }
+  parallel_for(n, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* rec = src + i * 3072;
+      float* out = dst + i * 3072;
+      for (int64_t px = 0; px < 1024; ++px) {
+        float* o = out + px * 3;
+        o[0] = static_cast<float>(rec[px]) * scale[0] - shift[0];
+        o[1] = static_cast<float>(rec[1024 + px]) * scale[1] - shift[1];
+        o[2] = static_cast<float>(rec[2048 + px]) * scale[2] - shift[2];
+      }
+    }
+  });
+}
+
+// Row gather: dst[j] = src[idx[j]] for float32 rows of row_elems elements.
+void gather_rows_f32(const float* src, const int64_t* idx, float* dst,
+                     int64_t n_idx, int64_t row_elems) {
+  parallel_for(n_idx, [&](int64_t lo, int64_t hi) {
+    for (int64_t j = lo; j < hi; ++j) {
+      std::memcpy(dst + j * row_elems, src + idx[j] * row_elems,
+                  sizeof(float) * static_cast<size_t>(row_elems));
+    }
+  });
+}
+
+// Same for int32 rows (labels / multi-hot targets).
+void gather_rows_i32(const int32_t* src, const int64_t* idx, int32_t* dst,
+                     int64_t n_idx, int64_t row_elems) {
+  parallel_for(n_idx, [&](int64_t lo, int64_t hi) {
+    for (int64_t j = lo; j < hi; ++j) {
+      std::memcpy(dst + j * row_elems, src + idx[j] * row_elems,
+                  sizeof(int32_t) * static_cast<size_t>(row_elems));
+    }
+  });
+}
+
+int cifar_codec_abi_version() { return 1; }
+
+}  // extern "C"

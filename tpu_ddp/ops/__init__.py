@@ -1,0 +1,12 @@
+"""Pallas TPU kernels (the framework's custom-kernel layer).
+
+The reference's custom-kernel story is cuDNN/cuBLAS via ATen (SURVEY.md
+§2.6); on TPU, XLA already fuses the CNN stack well, so the in-tree Pallas
+surface targets the op XLA handles least optimally at scale: attention.
+Kernels are opt-in (models default to XLA-compiled jnp) and every kernel has
+a jnp reference implementation it is tested against.
+"""
+
+from tpu_ddp.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

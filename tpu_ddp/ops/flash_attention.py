@@ -1,0 +1,139 @@
+"""Flash attention (blockwise, online-softmax) as a Pallas TPU kernel.
+
+Single-device counterpart of the cross-device ring attention
+(``tpu_ddp.parallel.ring_attention``): same math, but the K/V blocks stream
+through VMEM on one core instead of rotating around the ICI ring. Memory is
+O(T_q_block * T) scores per step instead of materializing the full (T, T)
+matrix in HBM, and the QK^T / PV matmuls hit the MXU tile-by-tile.
+
+Layout: (B, T, H, D) like the rest of the framework; internally heads fold
+into the grid. Head dim is zero-padded to the 128 lane width (padding k
+contributes 0 to scores; padding v yields padded output columns that are
+sliced away).
+
+Differentiation: forward is the Pallas kernel; backward recomputes with the
+jnp reference (exact same values up to reassociation) via ``jax.custom_vjp``
+— standard practice for inference-heavy paths; a Pallas backward kernel is
+a later optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+
+
+def _reference(q, k, v):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+            scale: float, n_k: int):
+    """One (q-block, kv-block) tile. The kv-block index is the innermost
+    grid dim, so for a fixed q block the kernel runs n_k times back-to-back
+    with VMEM scratch (acc/m/l) carrying the online-softmax state — only one
+    (bq, d) + (bk, d) tile pair is resident per step; K/V stream from HBM
+    block-by-block via the BlockSpec pipeline."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full(m_ref.shape, -jnp.inf, jnp.float32)
+        l_ref[:] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    q = q_ref[0]  # (bq, d)
+    s = jnp.dot(q, k_ref[0].T, preferred_element_type=jnp.float32) * scale
+    m_prev = m_ref[:, 0:1]  # (bq, 1)
+    l_prev = l_ref[:, 0:1]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+        p, v_ref[0], preferred_element_type=jnp.float32
+    )
+    m_ref[:, 0:1] = m_new
+    l_ref[:, 0:1] = l_new
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / l_ref[:, 0:1]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, block_q: int, block_k: int, interpret: bool):
+    B, T, H, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+    d_pad = max(LANE, ((D + LANE - 1) // LANE) * LANE)
+
+    def fold(x):  # (B,T,H,D) -> (B*H, T, Dpad)
+        x = x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+        if d_pad != D:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, d_pad - D)))
+        return x
+
+    bq = min(block_q, T)
+    bk = min(block_k, T)
+    assert T % bq == 0 and T % bk == 0, (
+        f"sequence length {T} must divide block sizes ({bq}, {bk})"
+    )
+    qf, kf, vf = fold(q), fold(k), fold(v)
+    n_k = T // bk
+    grid = (B * H, T // bq, n_k)  # kv-block innermost: sequential carry
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, n_k=n_k),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, d_pad), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d_pad), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d_pad), lambda i, j, kk: (i, kk, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d_pad), lambda i, j, kk: (i, kk, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d_pad), lambda i, j, kk: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d_pad), jnp.float32),  # acc
+            pltpu.VMEM((bq, LANE), jnp.float32),   # running max
+            pltpu.VMEM((bq, LANE), jnp.float32),   # running denom
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out[:, :, :D].reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None):
+    """(B, T, H, D) non-causal attention. ``interpret`` defaults to True off
+    TPU (CPU tests) and False on TPU."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, block_q=block_q, block_k=block_k,
+                          interpret=interpret)
+
+
+def _fwd(q, k, v, block_q, block_k, interpret):
+    return flash_attention(q, k, v, block_q, block_k, interpret), (q, k, v)
+
+
+def _bwd(block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(_reference, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -54,20 +54,30 @@ def make_train_step(
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
     compute_accuracy: bool = True,
+    remat: bool = False,
 ) -> Callable[[TrainState, Batch], tuple]:
     """Build the compiled DDP train step for `mesh`.
 
     Returns step(state, batch) -> (state, metrics) where batch is a global
     {image, label, mask} dict sharded on its leading axis over `data_axis`.
     ``compute_accuracy=False`` for losses whose labels aren't class indices
-    (e.g. multi-hot BCE targets).
+    (e.g. multi-hot BCE targets). ``remat=True`` rematerializes the forward
+    during backward (jax.checkpoint) — trades FLOPs for HBM on deep models.
     """
 
-    def compute_loss(params, batch_stats, batch):
-        variables = {"params": params, "batch_stats": batch_stats}
-        logits, mutated = model.apply(
-            variables, batch["image"], train=True, mutable=["batch_stats"]
+    def apply_model(params, batch_stats, images):
+        return model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            images,
+            train=True,
+            mutable=["batch_stats"],
         )
+
+    if remat:
+        apply_model = jax.checkpoint(apply_model)
+
+    def compute_loss(params, batch_stats, batch):
+        logits, mutated = apply_model(params, batch_stats, batch["image"])
         loss = loss_fn(logits, batch["label"], batch.get("mask"))
         # Gradient sync lives HERE: pmean-ing the per-shard loss before
         # differentiation makes reverse-mode AD produce the globally

@@ -50,6 +50,9 @@ class TrainConfig:
     shuffle: bool = True
     reshuffle_each_epoch: bool = True     # False = faithful missing-set_epoch
     sync_bn: bool = False
+    compute_dtype: str = "float32"        # float32 | bfloat16 (MXU 2x)
+    remat: bool = False                   # jax.checkpoint the forward:
+                                          # trade FLOPs for HBM on big models
     model: str = "netresdeep"
     tied_blocks: bool = True              # the reference's weight-tying quirk
     num_classes: int = 10
@@ -69,20 +72,25 @@ class TrainConfig:
 
 
 def build_model(config: TrainConfig):
+    import jax.numpy as jnp
+
     from tpu_ddp.models import NetResDeep
     from tpu_ddp.models.zoo import MODEL_REGISTRY
 
     bn_axis = DATA_AXIS if config.sync_bn else None
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[config.compute_dtype]
     name = config.model.lower()
     if name == "netresdeep":
         return NetResDeep(
             tied=config.tied_blocks,
             num_classes=config.num_classes,
             bn_cross_replica_axis=bn_axis,
+            dtype=dtype,
         )
     if name in MODEL_REGISTRY:
         return MODEL_REGISTRY[name](
-            num_classes=config.num_classes, bn_cross_replica_axis=bn_axis
+            num_classes=config.num_classes, bn_cross_replica_axis=bn_axis,
+            dtype=dtype,
         )
     raise ValueError(f"unknown model {config.model!r}")
 
@@ -146,7 +154,7 @@ class Trainer:
             raise ValueError(f"unknown loss {config.loss!r}")
         self.train_step = make_train_step(
             self.model, self.tx, self.mesh,
-            loss_fn=loss_fn, compute_accuracy=with_acc,
+            loss_fn=loss_fn, compute_accuracy=with_acc, remat=config.remat,
         )
         self.eval_step = make_eval_step(
             self.model, self.mesh, loss_fn=loss_fn, compute_accuracy=with_acc
