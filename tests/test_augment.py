@@ -1,0 +1,72 @@
+"""On-device augmentation (random crop + flip) — the recipe extension the
+reference lacks entirely (transform is ToTensor+Normalize only,
+``/root/reference/main.py:54-58``; SURVEY.md §7.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_ddp.data.augment import random_crop_flip
+
+
+def _batch(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(n, 32, 32, 3)).astype(np.float32))
+
+
+def test_shape_and_dtype_preserved():
+    x = _batch()
+    out = random_crop_flip(jax.random.key(0), x)
+    assert out.shape == x.shape
+    assert out.dtype == x.dtype
+
+
+def test_deterministic_given_key():
+    x = _batch()
+    a = random_crop_flip(jax.random.key(7), x)
+    b = random_crop_flip(jax.random.key(7), x)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_keys_give_different_augmentations():
+    x = _batch()
+    a = random_crop_flip(jax.random.key(0), x)
+    b = random_crop_flip(jax.random.key(1), x)
+    assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_no_pad_no_flip_is_identity():
+    x = _batch()
+    out = random_crop_flip(jax.random.key(0), x, pad=0, flip_prob=0.0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_crop_content_comes_from_padded_image():
+    # pad=4, flip off: every output row/col window must appear in the
+    # zero-padded input at the sampled offset; just verify values are a
+    # subset of {0} ∪ original values.
+    x = _batch(n=4)
+    out = np.asarray(random_crop_flip(jax.random.key(3), x, flip_prob=0.0))
+    vals = set(np.asarray(x).ravel().tolist()) | {0.0}
+    assert set(out.ravel().tolist()) <= vals
+
+
+def test_train_step_with_augmentation(devices):
+    from tpu_ddp.data import synthetic_cifar10
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
+    from tpu_ddp.train import create_train_state, make_optimizer, make_train_step
+
+    mesh = create_mesh(MeshSpec(data=-1), devices)
+    model = NetResDeep(n_chans1=8, n_blocks=2)
+    tx = make_optimizer(lr=1e-2)
+    state = create_train_state(model, tx, jax.random.key(0))
+    step = make_train_step(model, tx, mesh, augment=True, augment_seed=5)
+
+    imgs, labels = synthetic_cifar10(8 * len(devices), seed=0)
+    batch = jax.device_put(
+        {"image": imgs, "label": labels, "mask": np.ones(len(labels), bool)},
+        batch_sharding(mesh),
+    )
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))

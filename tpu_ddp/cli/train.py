@@ -54,6 +54,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="rematerialize the forward in backward "
                         "(jax.checkpoint): fits deeper models in HBM")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--augment", action="store_true",
+                   help="on-device random crop+flip (the reference has no "
+                        "augmentation; needed for the 93%% target, "
+                        "SURVEY.md §7.3)")
     p.add_argument("--no-shuffle", action="store_true")
     p.add_argument("--faithful-epoch-order", action="store_true",
                    help="reproduce the missing set_epoch(): same order every epoch")
@@ -110,6 +114,7 @@ def config_from_args(args) -> TrainConfig:
         seed=args.seed,
         shuffle=not args.no_shuffle,
         reshuffle_each_epoch=not args.faithful_epoch_order,
+        augment=args.augment,
         sync_bn=args.sync_bn,
         compute_dtype=args.compute_dtype,
         remat=args.remat,

@@ -26,12 +26,31 @@ AVAILABLE = False
 _lib = None
 
 
+def _user_cache_dir() -> str:
+    """Per-user, 0700 cache dir — never a world-writable shared /tmp path
+    (another user could otherwise pre-plant a .so that CDLL would execute)."""
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        tempfile.gettempdir(), f"tpu_ddp_native_{os.getuid()}"
+    )
+    path = os.path.join(base, "tpu_ddp_native") if "XDG_CACHE_HOME" in os.environ else base
+    os.makedirs(path, mode=0o700, exist_ok=True)
+    if os.stat(path).st_uid != os.getuid():
+        raise OSError(f"cache dir {path} owned by another user")
+    return path
+
+
 def _build_and_load():
     global AVAILABLE, _lib
-    # Prefer a prebuilt .so next to the source; else build into a cache dir.
+    # Prefer a prebuilt .so next to the source; else build into a per-user
+    # cache dir.
+    try:
+        cache = _user_cache_dir()
+    except OSError as e:
+        log.warning("native cifar_codec cache unusable (%s); numpy fallback", e)
+        return
     candidates = [
         os.path.join(os.path.dirname(__file__), _LIB_NAME),
-        os.path.join(tempfile.gettempdir(), "tpu_ddp_native", _LIB_NAME),
+        os.path.join(cache, _LIB_NAME),
     ]
     for path in candidates:
         if os.path.exists(path) and os.path.getmtime(path) >= os.path.getmtime(_SRC):
@@ -41,18 +60,22 @@ def _build_and_load():
             except OSError:
                 pass
     if _lib is None:
-        build_dir = os.path.dirname(candidates[1])
-        os.makedirs(build_dir, exist_ok=True)
         out = candidates[1]
+        # Build to a process-unique temp name, then rename atomically so a
+        # concurrent importer never dlopens a half-written file.
+        tmp_out = f"{out}.{os.getpid()}.tmp"
         cmd = [
             "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-            "-o", out, _SRC, "-lpthread",
+            "-o", tmp_out, _SRC, "-lpthread",
         ]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp_out, out)
             _lib = ctypes.CDLL(out)
         except Exception as e:  # toolchain missing/failed -> numpy fallback
             log.warning("native cifar_codec build failed (%s); numpy fallback", e)
+            if os.path.exists(tmp_out):
+                os.unlink(tmp_out)
             return
     try:  # a stale/foreign prebuilt .so must degrade to numpy, not raise
         _lib.cifar_decode_normalize.argtypes = [
@@ -85,20 +108,19 @@ def decode_normalize(raw: np.ndarray, mean: np.ndarray, std: np.ndarray) -> np.n
     raw = np.ascontiguousarray(raw, np.uint8)
     n = raw.shape[0]
     assert raw.shape[1] == 3072
+    mean32 = np.ascontiguousarray(mean, np.float32)
+    std32 = np.ascontiguousarray(std, np.float32)
     if AVAILABLE:
         out = np.empty((n, 32, 32, 3), np.float32)
-        mean32 = np.ascontiguousarray(mean, np.float32)
-        std32 = np.ascontiguousarray(std, np.float32)
         _lib.cifar_decode_normalize(
             raw.ctypes.data, out.ctypes.data, n, mean32.ctypes.data,
             std32.ctypes.data,
         )
         return out
-    # numpy fallback: same transform as tpu_ddp.data.cifar10.normalize —
-    # reuse it so the formula lives in exactly one place
-    from tpu_ddp.data.cifar10 import normalize
-
-    return normalize(raw.reshape(n, 3, 32, 32).transpose(0, 2, 3, 1))
+    # numpy fallback: identical transform (/255 "ToTensor" then per-channel
+    # stats), honoring the SAME mean/std arguments as the native path
+    x = raw.reshape(n, 3, 32, 32).transpose(0, 2, 3, 1).astype(np.float32) / 255.0
+    return (x - mean32) / std32
 
 
 # Below this, the per-call std::thread fan-out costs more than the copy.

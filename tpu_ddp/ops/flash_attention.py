@@ -28,6 +28,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 LANE = 128
+# Below this block size the Pallas grid degenerates (per-row kernel launches);
+# fall back to the fused jnp reference instead.
+_MIN_BLOCK = 8
 
 
 def _reference(q, k, v):
@@ -82,11 +85,21 @@ def _flash_forward(q, k, v, *, block_q: int, block_k: int, interpret: bool):
             x = jnp.pad(x, ((0, 0), (0, 0), (0, d_pad - D)))
         return x
 
-    bq = min(block_q, T)
-    bk = min(block_k, T)
-    assert T % bq == 0 and T % bk == 0, (
-        f"sequence length {T} must divide block sizes ({bq}, {bk})"
-    )
+    # Largest divisor of T not exceeding the requested block: sequence
+    # lengths that aren't powers of two (e.g. ViT-B/16's 196 tokens) get a
+    # working tiling automatically instead of an assertion.
+    def fit_block(want: int) -> int:
+        want = min(want, T)
+        while T % want:
+            want -= 1
+        return want
+
+    bq = fit_block(block_q)
+    bk = fit_block(block_k)
+    if min(bq, bk) < _MIN_BLOCK:
+        # No usable tiling (e.g. prime T): a (1, d) grid would be
+        # pathological. The fused jnp path is the right tool there.
+        return _reference(q, k, v)
     qf, kf, vf = fold(q), fold(k), fold(v)
     n_k = T // bk
     grid = (B * H, T // bq, n_k)  # kv-block innermost: sequential carry

@@ -24,6 +24,26 @@ from tpu_ddp.parallel.runtime import (
     device_count,
     local_device_count,
 )
+from tpu_ddp.parallel.partitioning import (
+    PartitionRule,
+    fsdp_specs,
+    opt_state_specs,
+    shard_train_state,
+    specs_for_params,
+    train_state_shardings,
+)
+from tpu_ddp.parallel.tensor_parallel import (
+    VIT_TP_RULES,
+    make_fsdp_train_step,
+    make_sharded_train_step,
+    make_tp_train_step,
+)
+from tpu_ddp.parallel.pipeline import (
+    create_pp_train_state,
+    from_pipeline_params,
+    make_pp_train_step,
+    to_pipeline_params,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -39,4 +59,18 @@ __all__ = [
     "is_primary_process",
     "device_count",
     "local_device_count",
+    "PartitionRule",
+    "fsdp_specs",
+    "opt_state_specs",
+    "shard_train_state",
+    "specs_for_params",
+    "train_state_shardings",
+    "VIT_TP_RULES",
+    "make_fsdp_train_step",
+    "make_sharded_train_step",
+    "make_tp_train_step",
+    "create_pp_train_state",
+    "from_pipeline_params",
+    "make_pp_train_step",
+    "to_pipeline_params",
 ]

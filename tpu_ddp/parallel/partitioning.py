@@ -1,0 +1,143 @@
+"""Parameter-partitioning rule engine for GSPMD model parallelism.
+
+The reference has no tensor/FSDP/ZeRO sharding of any kind (SURVEY.md §2.3:
+full replica of model and optimizer state per process, ``main.py:27,62-63``).
+This module is the TPU-native machinery that goes beyond it: declare *rules*
+mapping parameter paths to ``PartitionSpec``s, lay the whole ``TrainState``
+out on the mesh with them, and let the XLA partitioner (GSPMD) insert the
+all-gathers / reduce-scatters — the scaling-book recipe ("pick a mesh,
+annotate shardings, let XLA insert collectives").
+
+Optimizer state is sharded *like the parameters it mirrors* (momentum/Adam
+trees embed the param tree as a subtree — matched here by path suffix), which
+is exactly the ZeRO observation: per-param optimizer state never needs more
+replication than the param itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _path_str(path: tuple) -> str:
+    """('block_0','attn','qkv','kernel') -> 'block_0/attn/qkv/kernel'."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionRule:
+    """First rule whose regex matches (``re.search``) the param's path string
+    wins; unmatched params are replicated."""
+
+    pattern: str
+    spec: P
+
+    def matches(self, path_str: str) -> bool:
+        return re.search(self.pattern, path_str) is not None
+
+
+def specs_for_params(params: Any, rules: Sequence[PartitionRule]) -> Any:
+    """Tree of PartitionSpec, same structure as `params`."""
+
+    def pick(path, leaf):
+        del leaf
+        s = _path_str(path)
+        for rule in rules:
+            if rule.matches(s):
+                return rule.spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(pick, params)
+
+
+def fsdp_specs(params: Any, axis: str, axis_size: int) -> Any:
+    """ZeRO-3/FSDP-style specs: shard each param's LARGEST axis-size-divisible
+    dimension over `axis`; params with no divisible dim (or too small to be
+    worth scattering) stay replicated."""
+
+    def pick(leaf):
+        shape = getattr(leaf, "shape", ())
+        if not shape or max(shape) < 2 * axis_size:
+            return P()
+        dims = sorted(range(len(shape)), key=lambda d: -shape[d])
+        for d in dims:
+            if shape[d] % axis_size == 0:
+                spec = [None] * len(shape)
+                spec[d] = axis
+                return P(*spec)
+        return P()
+
+    return jax.tree.map(pick, params)
+
+
+def opt_state_specs(opt_state: Any, param_specs: Any) -> Any:
+    """Specs for an optax state tree: leaves whose path ends with a param's
+    path (momentum/trace/mu/nu mirror the param tree) inherit that param's
+    spec; everything else (step counts, scalars) is replicated."""
+    by_suffix = {}
+    flat = jax.tree_util.tree_flatten_with_path(
+        param_specs, is_leaf=lambda x: isinstance(x, P)
+    )[0]
+    for path, spec in flat:
+        by_suffix[tuple(_path_str((k,)) for k in path)] = spec
+
+    def pick(path, leaf):
+        del leaf
+        parts = tuple(_path_str((k,)) for k in path)
+        for plen in range(len(parts), 0, -1):
+            spec = by_suffix.get(parts[-plen:])
+            if spec is not None:
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(pick, opt_state)
+
+
+def train_state_shardings(
+    state: Any,
+    mesh: Mesh,
+    param_specs: Any,
+    *,
+    batch_stats_spec: Optional[P] = None,
+) -> Any:
+    """NamedSharding tree for a full TrainState: params by `param_specs`,
+    opt_state by suffix-match, step/batch_stats replicated (BN stats are tiny
+    and every shard-group needs them)."""
+
+    def to_sharding(spec_tree):
+        return jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            spec_tree,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    replicated = NamedSharding(mesh, P())
+    return state.replace(
+        step=replicated,
+        params=to_sharding(param_specs),
+        batch_stats=jax.tree.map(
+            lambda _: NamedSharding(mesh, batch_stats_spec or P()),
+            state.batch_stats,
+        ),
+        opt_state=to_sharding(opt_state_specs(state.opt_state, param_specs)),
+    )
+
+
+def shard_train_state(state: Any, shardings: Any) -> Any:
+    """Lay an (unsharded / freshly-initialized) TrainState out on the mesh."""
+    return jax.device_put(state, shardings)

@@ -1,0 +1,251 @@
+"""Pipeline parallelism (GPipe-style) over the ``pipeline`` mesh axis.
+
+Absent from the reference (SURVEY.md §2.3: "no stage splitting, no
+microbatching"); built TPU-first: the transformer trunk is split into S
+stages of ``depth/S`` blocks, each stage's block parameters live on one ring
+position of the ``pipeline`` axis, and microbatch activations rotate through
+the ring with ``lax.ppermute`` inside a ``lax.scan`` — the classic
+S + M - 1-tick schedule, fully compiled (no Python per-tick control flow, no
+per-stage processes; XLA overlaps the ppermute with the next tick's compute).
+
+Composes with data parallelism on a 2-D ``data x pipeline`` mesh: the batch
+is sharded over ``data``, stages over ``pipeline``, and gradient averaging
+over ``data`` falls out of shard_map's unvarying-input transpose exactly as
+in the DDP step (tpu_ddp.train.steps).
+
+Design notes (how the grads stay correct without a hand-written backward):
+  * stage-0 ingestion is ``where(stage == 0, fresh_embed, carried)`` — the
+    embed params' cotangent is nonzero only on stage 0, and shard_map's
+    psum-over-pipeline for unvarying params turns that into THE embed grad;
+  * the head runs on every stage but the loss reads logits through
+    ``psum(where(stage == S-1, logits, 0))`` — only the last stage's head
+    application carries gradient, so the psum'd head grad is the single
+    correct contribution (no double counting);
+  * per-stage block params are *varying* over the pipeline axis, so their
+    grads stay local to their stage — no collective at all.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_ddp.models.vit import TransformerBlock
+from tpu_ddp.parallel.mesh import DATA_AXIS, PIPELINE_AXIS
+from tpu_ddp.train.losses import cross_entropy_loss, masked_accuracy
+from tpu_ddp.train.state import TrainState
+
+
+def to_pipeline_params(params: dict, depth: int) -> dict:
+    """Plain ViT params -> pipeline layout: the ``block_i`` subtrees (all
+    structurally identical) stack into one ``blocks`` tree with a leading
+    stage-major depth axis; everything else passes through. Inverse:
+    ``from_pipeline_params`` — so plain checkpoints load into the pipeline
+    layout and back."""
+    blocks = [params[f"block_{i}"] for i in range(depth)]
+    rest = {k: v for k, v in params.items() if not k.startswith("block_")}
+    rest["blocks"] = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    return rest
+
+
+def from_pipeline_params(pp_params: dict, depth: int) -> dict:
+    out = {k: v for k, v in pp_params.items() if k != "blocks"}
+    for i in range(depth):
+        out[f"block_{i}"] = jax.tree.map(lambda x, i=i: x[i], pp_params["blocks"])
+    return out
+
+
+def make_pp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    state_template: TrainState,
+    *,
+    n_microbatches: int,
+    data_axis: str = DATA_AXIS,
+    pipe_axis: str = PIPELINE_AXIS,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+):
+    """Compiled pipeline-parallel train step for a ``tpu_ddp.models.vit.ViT``.
+
+    Returns ``(step, state_shardings)`` (same contract as the TP/FSDP
+    factories in tpu_ddp.parallel.tensor_parallel); lay the state out with
+    ``shard_train_state(state, state_shardings)``. ``state_template`` must
+    use the pipeline param layout (``create_pp_train_state`` /
+    ``to_pipeline_params``); the batch is the usual global
+    {image, label, mask} sharded over ``data_axis``. The per-data-shard batch
+    must divide into ``n_microbatches`` equal microbatches.
+    """
+    n_stages = mesh.shape[pipe_axis]
+    if model.depth % n_stages:
+        raise ValueError(f"depth {model.depth} not divisible by {n_stages} stages")
+    cfg = dict(dtype=model.dtype)
+    patch = nn.Conv(
+        model.hidden_dim,
+        kernel_size=(model.patch_size, model.patch_size),
+        strides=(model.patch_size, model.patch_size),
+        **cfg,
+    )
+    block = TransformerBlock(model.num_heads, mlp_ratio=model.mlp_ratio, **cfg)
+    ln_f = nn.LayerNorm(**cfg)
+    head = nn.Dense(model.num_classes, **cfg)
+
+    def embed(params, images):  # (mb, H, W, C) -> (mb, T, hidden)
+        x = patch.apply({"params": params["patch_embed"]}, images)
+        x = x.reshape(x.shape[0], -1, model.hidden_dim)
+        return x + params["pos_embed"].astype(x.dtype)
+
+    def apply_stage(stage_blocks, x):
+        def body(x, p):
+            return block.apply({"params": p}, x), None
+
+        x, _ = lax.scan(body, x, stage_blocks)
+        return x
+
+    def apply_head(params, x):  # (mb, T, hidden) -> (mb, classes)
+        x = ln_f.apply({"params": params["ln_f"]}, x)
+        x = x.mean(axis=1)
+        return head.apply({"params": params["head"]}, x).astype(jnp.float32)
+
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def forward(params, images):
+        """Per-device pipelined forward: images (local_batch, H, W, C) ->
+        logits (local_batch, classes), replicated over the pipeline axis."""
+        stage = lax.axis_index(pipe_axis)
+        local = images.shape[0]
+        assert local % n_microbatches == 0, (
+            f"per-shard batch {local} not divisible into {n_microbatches} "
+            "microbatches"
+        )
+        mb = local // n_microbatches
+        embedded = embed(params, images).reshape(
+            n_microbatches, mb, -1, model.hidden_dim
+        )
+        # Under shard_map the P(pipe_axis) spec already hands this device its
+        # contiguous (depth/S, ...) chunk — stage s holds blocks
+        # [s*depth/S, (s+1)*depth/S).
+        stage_blocks = params["blocks"]
+
+        m = n_microbatches
+        outs = jnp.zeros_like(embedded)
+        act = jnp.zeros(embedded.shape[1:], embedded.dtype)
+        # The tick body makes the carry vary over the pipeline axis (stage
+        # index, ppermute); shard_map's varying-axes tracking requires the
+        # initial carry to carry the same marking.
+        if hasattr(lax, "pcast"):
+            act = lax.pcast(act, (data_axis, pipe_axis), to="varying")
+            outs = lax.pcast(outs, (pipe_axis,), to="varying")
+
+        def tick(carry, t):
+            act, outs = carry
+            fresh = embedded[jnp.clip(t, 0, m - 1)]
+            act = jnp.where(stage == 0, fresh, act)
+            act = apply_stage(stage_blocks, act)
+            m_out = t - (n_stages - 1)
+            idx = jnp.clip(m_out, 0, m - 1)
+            cur = lax.dynamic_index_in_dim(outs, idx, keepdims=False)
+            new = jnp.where((stage == n_stages - 1) & (m_out >= 0), act, cur)
+            outs = lax.dynamic_update_index_in_dim(outs, new, idx, 0)
+            act = lax.ppermute(act, pipe_axis, fwd_perm)
+            return (act, outs), None
+
+        (_, outs), _ = lax.scan(
+            tick, (act, outs), jnp.arange(m + n_stages - 1)
+        )
+        logits = apply_head(params, outs.reshape(local, -1, model.hidden_dim))
+        # Only the last stage's logits are real; broadcast them. Gradient
+        # flows back through the where-mask to the last stage alone.
+        return lax.psum(
+            jnp.where(stage == n_stages - 1, logits, jnp.zeros_like(logits)),
+            pipe_axis,
+        )
+
+    def compute_loss(params, batch):
+        logits = forward(params, batch["image"])
+        loss = loss_fn(logits, batch["label"], batch.get("mask"))
+        return lax.pmean(loss, data_axis), logits
+
+    def shard_step(state: TrainState, batch):
+        (loss, logits), grads = jax.value_and_grad(compute_loss, has_aux=True)(
+            state.params, batch
+        )
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        correct, count = masked_accuracy(logits, batch["label"], batch.get("mask"))
+        metrics = {
+            "loss": loss,
+            "accuracy": lax.psum(correct, data_axis)
+            / jnp.maximum(lax.psum(count, data_axis), 1.0),
+        }
+        return (
+            state.replace(
+                step=state.step + 1, params=new_params, opt_state=new_opt_state
+            ),
+            metrics,
+        )
+
+    def param_specs(params):
+        return {
+            k: (
+                jax.tree.map(lambda _: P(pipe_axis), v)
+                if k == "blocks"
+                else jax.tree.map(lambda _: P(), v)
+            )
+            for k, v in params.items()
+        }
+
+    # opt_state mirrors params (momentum trees): reuse the suffix matcher
+    from tpu_ddp.parallel.partitioning import opt_state_specs
+
+    def state_specs(state):
+        specs = param_specs(state.params)
+        return state.replace(
+            step=P(),
+            params=specs,
+            batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
+            opt_state=opt_state_specs(state.opt_state, specs),
+        )
+
+    specs = state_specs(jax.eval_shape(lambda: state_template))
+    batch_specs = {
+        "image": P(data_axis),
+        "label": P(data_axis),
+        "mask": P(data_axis),
+    }
+    sharded = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(specs, batch_specs),
+        out_specs=(specs, P()),
+    )
+    step = jax.jit(sharded, donate_argnums=(0,) if donate else ())
+    from jax.sharding import NamedSharding
+
+    shardings = jax.tree.map(
+        lambda sp: NamedSharding(mesh, sp),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return step, shardings
+
+
+def create_pp_train_state(model, tx, rng, input_shape=(1, 32, 32, 3)) -> TrainState:
+    """Init a plain ViT and convert to the pipeline param layout (optimizer
+    state initialized on the converted tree so momentum stacks match)."""
+    variables = model.init(rng, jnp.zeros(input_shape, jnp.float32), train=False)
+    params = to_pipeline_params(variables["params"], model.depth)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats={},
+        opt_state=tx.init(params),
+    )

@@ -1,0 +1,170 @@
+"""Tensor parallelism (Megatron-style) and FSDP/ZeRO via GSPMD.
+
+Absent from the reference (SURVEY.md §2.3: "no layer sharding anywhere");
+built TPU-first as the scaling-book recipe: the model's big matmuls are
+*annotated* with a ``model``-axis layout and the XLA partitioner inserts the
+collectives — no hand-written all-gathers, and comm/compute overlap comes
+from the XLA latency-hiding scheduler.
+
+The layout is the classic pair-of-matmuls scheme: qkv / mlp_up kernels are
+column-sharded ``P(None, 'model')`` (each device computes its slice of heads
+/ hidden), proj / mlp_down kernels are row-sharded ``P('model', None)`` (the
+contraction dim is sharded, XLA closes with one reduce-scatter/all-reduce per
+block). Activations between the two matmuls never materialize unsharded.
+
+``make_sharded_train_step`` is rule-agnostic: pass TP rules, ``fsdp_specs``
+output, or any mix (e.g. 2-D data x model mesh = DP+TP; fsdp over ``data`` =
+ZeRO-3). Same step code covers all of them — that's the point of GSPMD.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_ddp.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from tpu_ddp.parallel.partitioning import (
+    PartitionRule,
+    fsdp_specs,
+    specs_for_params,
+    shard_train_state,
+    train_state_shardings,
+)
+from tpu_ddp.train.losses import cross_entropy_loss
+from tpu_ddp.train.state import TrainState
+
+# Megatron-style layout for tpu_ddp.models.vit.ViT (paths like
+# block_3/attn/qkv/kernel, block_3/mlp_up/kernel, ...).
+VIT_TP_RULES = (
+    PartitionRule(r"attn/qkv/kernel$", P(None, MODEL_AXIS)),
+    PartitionRule(r"attn/qkv/bias$", P(MODEL_AXIS)),
+    PartitionRule(r"attn/proj/kernel$", P(MODEL_AXIS, None)),
+    PartitionRule(r"mlp_up/kernel$", P(None, MODEL_AXIS)),
+    PartitionRule(r"mlp_up/bias$", P(MODEL_AXIS)),
+    PartitionRule(r"mlp_down/kernel$", P(MODEL_AXIS, None)),
+)
+
+
+def make_sharded_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    param_specs: Any,
+    *,
+    data_axis: str = DATA_AXIS,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+    has_batch_stats: bool = False,
+):
+    """GSPMD train step: params laid out by `param_specs`, batch sharded over
+    `data_axis`; gradient averaging over the data axis and every TP collective
+    are inserted by the partitioner.
+
+    Returns a builder: call ``build(state_template)`` to get
+    ``(step, state_shardings)``; lay the initial state out with
+    ``shard_train_state(state, state_shardings)``. (The template is only
+    inspected abstractly — shapes, not buffers.)
+    """
+
+    def compute_loss(params, batch_stats, batch):
+        variables = {"params": params}
+        if has_batch_stats:
+            variables["batch_stats"] = batch_stats
+            logits, mutated = model.apply(
+                variables, batch["image"], train=True, mutable=["batch_stats"]
+            )
+            new_stats = mutated["batch_stats"]
+        else:
+            logits = model.apply(variables, batch["image"], train=True)
+            new_stats = batch_stats
+        loss = loss_fn(logits, batch["label"], batch.get("mask"))
+        return loss, new_stats
+
+    def step_fn(state: TrainState, batch):
+        (loss, new_stats), grads = jax.value_and_grad(
+            compute_loss, has_aux=True
+        )(state.params, state.batch_stats, batch)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            state.replace(
+                step=state.step + 1,
+                params=new_params,
+                batch_stats=new_stats,
+                opt_state=new_opt_state,
+            ),
+            {"loss": loss},
+        )
+
+    # One builder serves any state_template: shardings are computed from the
+    # abstract state so nothing here touches real buffers.
+    def build(state_template: TrainState):
+        shardings = train_state_shardings(
+            jax.eval_shape(lambda: state_template), mesh, param_specs
+        )
+        batch_shardings = {
+            "image": NamedSharding(mesh, P(data_axis)),
+            "label": NamedSharding(mesh, P(data_axis)),
+            "mask": NamedSharding(mesh, P(data_axis)),
+        }
+        step = jax.jit(
+            step_fn,
+            in_shardings=(shardings, batch_shardings),
+            out_shardings=(shardings, NamedSharding(mesh, P())),
+            donate_argnums=(0,) if donate else (),
+        )
+        return step, shardings
+
+    return build
+
+
+def make_tp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    state_template: TrainState,
+    *,
+    rules=VIT_TP_RULES,
+    data_axis: str = DATA_AXIS,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+):
+    """Tensor-parallel (optionally DP x TP on a 2-D mesh) ViT train step.
+
+    Returns (step, state_shardings)."""
+    param_specs = specs_for_params(state_template.params, rules)
+    build = make_sharded_train_step(
+        model, tx, mesh, param_specs,
+        data_axis=data_axis, loss_fn=loss_fn, donate=donate,
+    )
+    return build(state_template)
+
+
+def make_fsdp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    state_template: TrainState,
+    *,
+    shard_axis: str = DATA_AXIS,
+    data_axis: str = DATA_AXIS,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+    has_batch_stats: bool = False,
+):
+    """ZeRO-3/FSDP step: params + optimizer state scattered over `shard_axis`
+    (each device stores 1/N of every big tensor; XLA all-gathers params for
+    compute and reduce-scatters grads — memory per device drops ~Nx for
+    state). Returns (step, state_shardings)."""
+    axis_size = mesh.shape[shard_axis]
+    param_specs = fsdp_specs(state_template.params, shard_axis, axis_size)
+    build = make_sharded_train_step(
+        model, tx, mesh, param_specs,
+        data_axis=data_axis, loss_fn=loss_fn, donate=donate,
+        has_batch_stats=has_batch_stats,
+    )
+    return build(state_template)

@@ -55,6 +55,8 @@ def make_train_step(
     donate: bool = True,
     compute_accuracy: bool = True,
     remat: bool = False,
+    augment: bool = False,
+    augment_seed: int = 0,
 ) -> Callable[[TrainState, Batch], tuple]:
     """Build the compiled DDP train step for `mesh`.
 
@@ -63,6 +65,9 @@ def make_train_step(
     ``compute_accuracy=False`` for losses whose labels aren't class indices
     (e.g. multi-hot BCE targets). ``remat=True`` rematerializes the forward
     during backward (jax.checkpoint) — trades FLOPs for HBM on deep models.
+    ``augment=True`` applies on-device random crop+flip to the shard's images
+    (keyed by step and shard index — reproducible across resume, distinct
+    per device; the recipe extension the reference lacks, SURVEY.md §7.3).
     """
 
     def apply_model(params, batch_stats, images):
@@ -92,6 +97,12 @@ def make_train_step(
         return loss, (mutated["batch_stats"], logits)
 
     def shard_step(state: TrainState, batch: Batch):
+        if augment:
+            from tpu_ddp.data.augment import random_crop_flip
+
+            key = jax.random.fold_in(jax.random.key(augment_seed), state.step)
+            key = jax.random.fold_in(key, lax.axis_index(data_axis))
+            batch = dict(batch, image=random_crop_flip(key, batch["image"]))
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
         (loss, (new_stats, logits)), grads = grad_fn(
             state.params, state.batch_stats, batch

@@ -22,7 +22,7 @@ import jax
 import numpy as np
 
 from tpu_ddp.data.loader import ShardedBatchLoader
-from tpu_ddp.metrics import MetricLogger, StepTimer, Throughput
+from tpu_ddp.metrics import MetricLogger, Throughput
 from tpu_ddp.parallel.mesh import DATA_AXIS, MeshSpec, batch_sharding, create_mesh
 from tpu_ddp.train.optim import make_optimizer
 from tpu_ddp.train.state import create_train_state
@@ -49,6 +49,8 @@ class TrainConfig:
     seed: int = 0
     shuffle: bool = True
     reshuffle_each_epoch: bool = True     # False = faithful missing-set_epoch
+    augment: bool = False                 # on-device random crop+flip
+                                          # (reference has none; SURVEY §7.3)
     sync_bn: bool = False
     compute_dtype: str = "float32"        # float32 | bfloat16 (MXU 2x)
     remat: bool = False                   # jax.checkpoint the forward:
@@ -155,6 +157,7 @@ class Trainer:
         self.train_step = make_train_step(
             self.model, self.tx, self.mesh,
             loss_fn=loss_fn, compute_accuracy=with_acc, remat=config.remat,
+            augment=config.augment, augment_seed=config.seed,
         )
         self.eval_step = make_eval_step(
             self.model, self.mesh, loss_fn=loss_fn, compute_accuracy=with_acc
@@ -226,24 +229,41 @@ class Trainer:
     def run(self) -> dict:
         c = self.config
         start = time.time()
-        timer = StepTimer(warmup_steps=2)
         throughput = Throughput(n_chips=self.world_size)
         throughput.start()
         last_metrics = {}
+        # Steady-state step time: measured per epoch between REAL sync points
+        # (the device_get below), excluding the first epoch (XLA compile).
+        # A per-step host-side timer would only measure async dispatch.
+        steady_seconds = 0.0
+        steady_steps = 0
         start_epoch = int(self.state.step) // self.train_loader.steps_per_epoch
         for epoch in range(start_epoch + 1, c.epochs + 1):
             self.train_loader.set_epoch(epoch)
-            loss_sum, n_batches = 0.0, 0
+            epoch_t0 = time.perf_counter()
+            # Per-step losses stay ON DEVICE during the epoch: fetching them
+            # eagerly (the reference's per-batch ``loss.item()``,
+            # ``main.py:41``) would force a host sync every step and stall
+            # the async dispatch pipeline (SURVEY.md §3.1). One device_get at
+            # epoch end materializes them all.
+            step_losses = []
             epoch_metrics = None
+            n_steps = 0
             for batch in self.train_loader:
-                timer.tick()
                 self.state, epoch_metrics = self.train_step(
                     self.state, self._put(batch)
                 )
                 throughput.add(int(batch["mask"].sum()))
-                loss_sum += float(epoch_metrics["loss"])
-                n_batches += 1
-            mean_loss = loss_sum / max(n_batches, 1)
+                step_losses.append(epoch_metrics["loss"])
+                n_steps += 1
+            mean_loss = (
+                float(np.mean(jax.device_get(step_losses)))
+                if step_losses
+                else float("nan")
+            )
+            if epoch > start_epoch + 1:  # device_get above = a sync boundary
+                steady_seconds += time.perf_counter() - epoch_t0
+                steady_steps += n_steps
             self.history["epoch"].append(epoch)
             self.history["train_loss"].append(mean_loss)
             if epoch == 1 or epoch % c.log_every_epochs == 0:
@@ -293,7 +313,9 @@ class Trainer:
             self.logger.log_text(f"loss curves -> {c.plot_curves}")
         last_metrics.update(
             total_seconds=total,
-            mean_step_seconds=timer.mean_step_seconds,
+            mean_step_seconds=(
+                steady_seconds / steady_steps if steady_steps else float("nan")
+            ),
             images_per_sec=throughput.images_per_sec,
             images_per_sec_per_chip=throughput.images_per_sec_per_chip,
         )
