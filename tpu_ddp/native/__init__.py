@@ -29,13 +29,17 @@ _lib = None
 def _user_cache_dir() -> str:
     """Per-user, 0700 cache dir — never a world-writable shared /tmp path
     (another user could otherwise pre-plant a .so that CDLL would execute)."""
-    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
-        tempfile.gettempdir(), f"tpu_ddp_native_{os.getuid()}"
-    )
-    path = os.path.join(base, "tpu_ddp_native") if "XDG_CACHE_HOME" in os.environ else base
+    xdg = os.environ.get("XDG_CACHE_HOME")
+    if xdg:  # unset OR empty both fall through to the per-uid tmp dir
+        path = os.path.join(xdg, "tpu_ddp_native")
+    else:
+        path = os.path.join(
+            tempfile.gettempdir(), f"tpu_ddp_native_{os.getuid()}"
+        )
     os.makedirs(path, mode=0o700, exist_ok=True)
     if os.stat(path).st_uid != os.getuid():
         raise OSError(f"cache dir {path} owned by another user")
+    os.chmod(path, 0o700)  # makedirs mode is umask-masked / ignored if it existed
     return path
 
 

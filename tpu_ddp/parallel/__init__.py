@@ -16,6 +16,7 @@ from tpu_ddp.parallel.mesh import (
     MeshSpec,
     create_mesh,
     batch_sharding,
+    stacked_batch_sharding,
     replicated_sharding,
 )
 from tpu_ddp.parallel.runtime import (
@@ -32,18 +33,30 @@ from tpu_ddp.parallel.partitioning import (
     specs_for_params,
     train_state_shardings,
 )
-from tpu_ddp.parallel.tensor_parallel import (
-    VIT_TP_RULES,
-    make_fsdp_train_step,
-    make_sharded_train_step,
-    make_tp_train_step,
-)
-from tpu_ddp.parallel.pipeline import (
-    create_pp_train_state,
-    from_pipeline_params,
-    make_pp_train_step,
-    to_pipeline_params,
-)
+# tensor_parallel / pipeline pull in flax, optax, and the model zoo; load
+# them lazily (PEP 562) so mesh/runtime users don't pay their import cost
+# and no import cycle forms through tpu_ddp.train.
+_LAZY = {
+    "VIT_TP_RULES": "tensor_parallel",
+    "make_fsdp_train_step": "tensor_parallel",
+    "make_sharded_train_step": "tensor_parallel",
+    "make_tp_train_step": "tensor_parallel",
+    "create_pp_train_state": "pipeline",
+    "from_pipeline_params": "pipeline",
+    "make_pp_train_step": "pipeline",
+    "to_pipeline_params": "pipeline",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f"tpu_ddp.parallel.{_LAZY[name]}")
+        value = getattr(mod, name)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "DATA_AXIS",
@@ -54,6 +67,7 @@ __all__ = [
     "MeshSpec",
     "create_mesh",
     "batch_sharding",
+    "stacked_batch_sharding",
     "replicated_sharding",
     "initialize_distributed",
     "is_primary_process",

@@ -94,6 +94,13 @@ def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     return NamedSharding(mesh, P(axis))
 
 
+def stacked_batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Sharding for a K-stacked batch (K, global_batch, ...): the scan axis
+    is replicated, the batch axis sharded — the input layout of
+    ``make_scan_train_step``."""
+    return NamedSharding(mesh, P(None, axis))
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Fully-replicated — the reference's DDP model replication
     (``main.py:62-63``) without the wrapper or the ctor broadcast."""

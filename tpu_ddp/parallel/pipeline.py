@@ -94,7 +94,12 @@ def make_pp_train_step(
         strides=(model.patch_size, model.patch_size),
         **cfg,
     )
-    block = TransformerBlock(model.num_heads, mlp_ratio=model.mlp_ratio, **cfg)
+    block = TransformerBlock(
+        model.num_heads,
+        mlp_ratio=model.mlp_ratio,
+        attention_impl=model.attention_impl,
+        **cfg,
+    )
     ln_f = nn.LayerNorm(**cfg)
     head = nn.Dense(model.num_classes, **cfg)
 

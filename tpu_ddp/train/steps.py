@@ -45,30 +45,19 @@ from tpu_ddp.train.state import TrainState
 Batch = dict
 
 
-def make_train_step(
+def _make_shard_step(
     model,
     tx: optax.GradientTransformation,
-    mesh: Mesh,
     *,
     data_axis: str = DATA_AXIS,
     loss_fn: Callable = cross_entropy_loss,
-    donate: bool = True,
     compute_accuracy: bool = True,
     remat: bool = False,
     augment: bool = False,
     augment_seed: int = 0,
-) -> Callable[[TrainState, Batch], tuple]:
-    """Build the compiled DDP train step for `mesh`.
-
-    Returns step(state, batch) -> (state, metrics) where batch is a global
-    {image, label, mask} dict sharded on its leading axis over `data_axis`.
-    ``compute_accuracy=False`` for losses whose labels aren't class indices
-    (e.g. multi-hot BCE targets). ``remat=True`` rematerializes the forward
-    during backward (jax.checkpoint) — trades FLOPs for HBM on deep models.
-    ``augment=True`` applies on-device random crop+flip to the shard's images
-    (keyed by step and shard index — reproducible across resume, distinct
-    per device; the recipe extension the reference lacks, SURVEY.md §7.3).
-    """
+):
+    """Per-shard train-step body shared by the single-step and scanned
+    variants: forward, pmean'd loss (the gradient allreduce), optax update."""
 
     def apply_model(params, batch_stats, images):
         return model.apply(
@@ -126,10 +115,98 @@ def make_train_step(
             )
         return new_state, metrics
 
+    return shard_step
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+    compute_accuracy: bool = True,
+    remat: bool = False,
+    augment: bool = False,
+    augment_seed: int = 0,
+) -> Callable[[TrainState, Batch], tuple]:
+    """Build the compiled DDP train step for `mesh`.
+
+    Returns step(state, batch) -> (state, metrics) where batch is a global
+    {image, label, mask} dict sharded on its leading axis over `data_axis`.
+    ``compute_accuracy=False`` for losses whose labels aren't class indices
+    (e.g. multi-hot BCE targets). ``remat=True`` rematerializes the forward
+    during backward (jax.checkpoint) — trades FLOPs for HBM on deep models.
+    ``augment=True`` applies on-device random crop+flip to the shard's images
+    (keyed by step and shard index — reproducible across resume, distinct
+    per device; the recipe extension the reference lacks, SURVEY.md §7.3).
+    """
+    shard_step = _make_shard_step(
+        model,
+        tx,
+        data_axis=data_axis,
+        loss_fn=loss_fn,
+        compute_accuracy=compute_accuracy,
+        remat=remat,
+        augment=augment,
+        augment_seed=augment_seed,
+    )
     sharded = jax.shard_map(
         shard_step,
         mesh=mesh,
         in_specs=(P(), P(data_axis)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_scan_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    steps_per_call: int,
+    data_axis: str = DATA_AXIS,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+    compute_accuracy: bool = True,
+    remat: bool = False,
+    augment: bool = False,
+    augment_seed: int = 0,
+) -> Callable[[TrainState, Batch], tuple]:
+    """K train steps fused into ONE dispatch via ``lax.scan``.
+
+    The reference pays Python-interpreter + launcher overhead every batch
+    (the ``main.py:32-41`` hot loop crosses the host boundary per step); for
+    a 76K-param model on TPU that overhead dominates the step itself. Here
+    ``steps_per_call`` optimizer steps run inside a single jitted call: the
+    host stacks K global batches on a new leading axis and XLA executes the
+    whole scan on-device with zero intervening dispatches.
+
+    step(state, batches) -> (state, metrics) where every array in ``batches``
+    has shape (K, global_batch, ...) sharded over ``data_axis`` on axis 1,
+    and every metric leaf gains a leading (K,) axis (per-step losses, in
+    order — the trainer logs them exactly as if stepped one by one).
+    """
+    shard_step = _make_shard_step(
+        model,
+        tx,
+        data_axis=data_axis,
+        loss_fn=loss_fn,
+        compute_accuracy=compute_accuracy,
+        remat=remat,
+        augment=augment,
+        augment_seed=augment_seed,
+    )
+
+    def shard_multi(state: TrainState, batches: Batch):
+        return lax.scan(shard_step, state, batches, length=steps_per_call)
+
+    sharded = jax.shard_map(
+        shard_multi,
+        mesh=mesh,
+        in_specs=(P(), P(None, data_axis)),
         out_specs=(P(), P()),
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())

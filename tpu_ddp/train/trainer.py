@@ -53,6 +53,9 @@ class TrainConfig:
                                           # (reference has none; SURVEY §7.3)
     sync_bn: bool = False
     compute_dtype: str = "float32"        # float32 | bfloat16 (MXU 2x)
+    steps_per_call: int = 1               # >1: fuse K optimizer steps into
+                                          # one dispatch (lax.scan) — hides
+                                          # host overhead on small models
     remat: bool = False                   # jax.checkpoint the forward:
                                           # trade FLOPs for HBM on big models
     model: str = "netresdeep"
@@ -159,6 +162,24 @@ class Trainer:
             loss_fn=loss_fn, compute_accuracy=with_acc, remat=config.remat,
             augment=config.augment, augment_seed=config.seed,
         )
+        self.multi_step = None
+        # Clamp to the epoch length: a scan longer than the epoch would
+        # compile but never fill, silently running every step un-fused.
+        self.steps_per_call = min(
+            config.steps_per_call, self.train_loader.steps_per_epoch
+        )
+        if self.steps_per_call > 1:
+            from tpu_ddp.parallel.mesh import stacked_batch_sharding
+            from tpu_ddp.train.steps import make_scan_train_step
+
+            self.multi_step = make_scan_train_step(
+                self.model, self.tx, self.mesh,
+                steps_per_call=self.steps_per_call,
+                loss_fn=loss_fn, compute_accuracy=with_acc,
+                remat=config.remat,
+                augment=config.augment, augment_seed=config.seed,
+            )
+            self.stacked_sharding = stacked_batch_sharding(self.mesh)
         self.eval_step = make_eval_step(
             self.model, self.mesh, loss_fn=loss_fn, compute_accuracy=with_acc
         )
@@ -226,6 +247,12 @@ class Trainer:
     def _put(self, batch):
         return jax.device_put(batch, self.batch_sharding)
 
+    def _put_stacked(self, batches):
+        """Stack K host batches on a new leading (scan) axis for the fused
+        multi-step; batch axis stays sharded over the mesh."""
+        stacked = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+        return jax.device_put(stacked, self.stacked_sharding)
+
     def run(self) -> dict:
         c = self.config
         start = time.time()
@@ -249,15 +276,38 @@ class Trainer:
             step_losses = []
             epoch_metrics = None
             n_steps = 0
+            pending = []
             for batch in self.train_loader:
+                if self.multi_step is None:
+                    self.state, epoch_metrics = self.train_step(
+                        self.state, self._put(batch)
+                    )
+                    step_losses.append(epoch_metrics["loss"])
+                else:
+                    pending.append(batch)
+                    if len(pending) == self.steps_per_call:
+                        self.state, epoch_metrics = self.multi_step(
+                            self.state, self._put_stacked(pending)
+                        )
+                        step_losses.append(epoch_metrics["loss"])  # (K,)
+                        pending = []
+                throughput.add(int(batch["mask"].sum()))
+                n_steps += 1
+            # Epoch remainder smaller than steps_per_call: plain steps (the
+            # scan's stacked shapes are static, so no partial-K call).
+            for batch in pending:
                 self.state, epoch_metrics = self.train_step(
                     self.state, self._put(batch)
                 )
-                throughput.add(int(batch["mask"].sum()))
                 step_losses.append(epoch_metrics["loss"])
-                n_steps += 1
             mean_loss = (
-                float(np.mean(jax.device_get(step_losses)))
+                float(
+                    np.mean(
+                        np.concatenate(
+                            [np.atleast_1d(x) for x in jax.device_get(step_losses)]
+                        )
+                    )
+                )
                 if step_losses
                 else float("nan")
             )
@@ -272,7 +322,12 @@ class Trainer:
                     f"Epoch {epoch}, Training loss {mean_loss}"
                 )
                 extra = (
-                    {"train_accuracy": float(epoch_metrics["accuracy"])}
+                    # last step's accuracy; a fused call yields (K,) of them
+                    {
+                        "train_accuracy": float(
+                            np.asarray(epoch_metrics["accuracy"]).reshape(-1)[-1]
+                        )
+                    }
                     if "accuracy" in epoch_metrics
                     else {}
                 )
