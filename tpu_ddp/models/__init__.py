@@ -4,5 +4,6 @@ from tpu_ddp.models.resnet import NetResDeep, ResBlock
 from tpu_ddp.models.zoo import MODEL_REGISTRY
 import tpu_ddp.models.resnet_family  # noqa: F401  (registers resnet18..152)
 import tpu_ddp.models.vit  # noqa: F401  (registers vit_s4, vit_b16)
+import tpu_ddp.models.moe  # noqa: F401  (registers vit_moe_s4)
 
 __all__ = ["NetResDeep", "ResBlock", "MODEL_REGISTRY"]

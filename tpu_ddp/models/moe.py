@@ -1,0 +1,209 @@
+"""Mixture-of-Experts ViT — the expert-parallel model family.
+
+Absent from the reference (SURVEY.md §2.3: "Expert parallel (EP / MoE): NO");
+built TPU-first as the classic GShard/Switch formulation, which exists
+precisely because it maps onto XLA SPMD: routing is expressed as dense
+one-hot dispatch/combine einsums with *static* shapes (a fixed per-expert
+capacity), so the whole layer jits once, the expert matmuls stay large and
+MXU-shaped, and sharding the stacked expert weights over an ``expert`` mesh
+axis makes the partitioner insert the token all-to-all automatically.
+
+Components:
+- ``MoEMlp``      — top-1 (Switch) routed FFN with capacity + load-balance
+                    aux loss (sown into the ``aux_loss`` collection).
+- ``MoETransformerBlock`` — pre-LN block whose FFN is a ``MoEMlp``.
+- ``MoEViT``      — ViT that interleaves dense and MoE blocks
+                    (``moe_every``), same interface as ``models.vit.ViT``.
+
+Expert-parallel layout rules live in ``tpu_ddp.parallel.expert_parallel``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_ddp.models.vit import MultiHeadSelfAttention, TransformerBlock
+from tpu_ddp.models.zoo import register
+
+
+class MoEMlp(nn.Module):
+    """Switch-style top-1 routed FFN over ``num_experts`` experts.
+
+    Dispatch is the GShard dense formulation: a one-hot tensor
+    ``(B, T, E, capacity)`` routes each token to a slot in its expert's
+    fixed-size buffer; tokens past capacity are *dropped* (their MLP output
+    is zero — the residual connection in the enclosing block carries them
+    through unchanged, the standard Switch behavior). Router math runs in
+    f32 regardless of compute dtype (bf16 softmax routing is unstable).
+
+    Expert weights are stacked with a leading ``E`` dim — ``w_up (E, C, H)``,
+    ``w_down (E, H, C)`` — so expert parallelism is one PartitionSpec:
+    ``P('expert', None, None)``.
+    """
+
+    num_experts: int
+    capacity_factor: float = 1.25
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # (B, T, C) -> (B, T, C)
+        B, T, C = x.shape
+        E = self.num_experts
+        H = C * self.mlp_ratio
+        capacity = max(1, int(np.ceil(T * self.capacity_factor / E)))
+
+        # --- routing (f32) ---
+        logits = nn.Dense(E, dtype=jnp.float32, name="router")(
+            x.astype(jnp.float32)
+        )  # (B, T, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        gate = jnp.max(probs, axis=-1)                       # (B, T)
+        expert_idx = jnp.argmax(probs, axis=-1)              # (B, T)
+        mask = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # (B, T, E)
+
+        # Switch load-balance loss: E * sum_e fraction_e * mean_prob_e;
+        # equals 1.0 at perfect balance. Sown; the EP train step adds it
+        # to the task loss with a small weight.
+        frac = mask.mean(axis=1)                             # (B, E)
+        mean_prob = probs.mean(axis=1)                       # (B, E)
+        self.sow(
+            "aux_loss",
+            "load_balance",
+            E * jnp.mean(jnp.sum(frac * mean_prob, axis=-1)),
+        )
+
+        # --- capacity + dispatch/combine tensors ---
+        # position of each token in its expert's queue; -1 where this
+        # (token, expert) pair is unrouted. one_hot maps both -1 and
+        # >= capacity to the zero row, which implements dropping for free.
+        pos = jnp.cumsum(mask, axis=1) * mask - 1.0          # (B, T, E)
+        dispatch = jax.nn.one_hot(
+            pos.astype(jnp.int32), capacity, dtype=jnp.float32
+        )                                                    # (B, T, E, Cap)
+        combine = dispatch * gate[:, :, None, None]          # (B, T, E, Cap)
+
+        # --- expert computation (stacked, leading E dim) ---
+        xd = jnp.einsum(
+            "btec,btm->ebcm", dispatch.astype(self.dtype), x.astype(self.dtype)
+        )  # (E, B, Cap, C): under EP this einsum IS the token all-to-all
+        w_up = self.param(
+            "w_up", nn.initializers.lecun_normal(), (E, C, H), jnp.float32
+        )
+        b_up = self.param("b_up", nn.initializers.zeros, (E, H), jnp.float32)
+        w_down = self.param(
+            "w_down", nn.initializers.lecun_normal(), (E, H, C), jnp.float32
+        )
+        b_down = self.param("b_down", nn.initializers.zeros, (E, C), jnp.float32)
+
+        h = jnp.einsum(
+            "ebcm,emh->ebch", xd, w_up.astype(self.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(self.dtype) + b_up[:, None, None, :].astype(self.dtype)
+        h = nn.gelu(h)
+        out = jnp.einsum(
+            "ebch,ehm->ebcm", h, w_down.astype(self.dtype),
+            preferred_element_type=jnp.float32,
+        ).astype(self.dtype) + b_down[:, None, None, :].astype(self.dtype)
+
+        y = jnp.einsum(
+            "btec,ebcm->btm", combine.astype(self.dtype), out
+        )  # (B, T, C): the return all-to-all + weighted un-dispatch
+        return y
+
+
+class MoETransformerBlock(nn.Module):
+    """Pre-LN transformer block with a routed-MoE FFN (residuals carry
+    capacity-dropped tokens through unchanged)."""
+
+    num_heads: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        x = x + MultiHeadSelfAttention(
+            self.num_heads, dtype=self.dtype, name="attn"
+        )(y)
+        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        x = x + MoEMlp(
+            self.num_experts,
+            capacity_factor=self.capacity_factor,
+            mlp_ratio=self.mlp_ratio,
+            dtype=self.dtype,
+            name="moe",
+        )(y)
+        return x
+
+
+class MoEViT(nn.Module):
+    """ViT with every ``moe_every``-th FFN replaced by a routed MoE layer
+    (the Switch/GShard interleave). Interface-compatible with ``vit.ViT``."""
+
+    patch_size: int = 4
+    hidden_dim: int = 192
+    depth: int = 6
+    num_heads: int = 3
+    num_classes: int = 10
+    num_experts: int = 8
+    moe_every: int = 2
+    capacity_factor: float = 1.25
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.float32
+    # interface parity with the CNN zoo; a ViT has no BN
+    bn_cross_replica_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B = x.shape[0]
+        x = nn.Conv(
+            self.hidden_dim,
+            kernel_size=(self.patch_size, self.patch_size),
+            strides=(self.patch_size, self.patch_size),
+            dtype=self.dtype,
+            name="patch_embed",
+        )(x)
+        x = x.reshape(B, -1, self.hidden_dim)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, x.shape[1], self.hidden_dim),
+        )
+        x = x + pos.astype(x.dtype)
+        for i in range(self.depth):
+            if self.moe_every and (i + 1) % self.moe_every == 0:
+                x = MoETransformerBlock(
+                    self.num_heads,
+                    num_experts=self.num_experts,
+                    capacity_factor=self.capacity_factor,
+                    mlp_ratio=self.mlp_ratio,
+                    dtype=self.dtype,
+                    name=f"block_{i}",
+                )(x, train=train)
+            else:
+                x = TransformerBlock(
+                    self.num_heads,
+                    mlp_ratio=self.mlp_ratio,
+                    dtype=self.dtype,
+                    name=f"block_{i}",
+                )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        x = x.mean(axis=1)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+@register("vit_moe_s4")
+def vit_moe_s4(num_classes: int = 10, bn_cross_replica_axis=None,
+               dtype=jnp.float32):
+    """Small MoE ViT for 32x32 inputs: 8 experts, MoE every other block."""
+    return MoEViT(patch_size=4, hidden_dim=192, depth=6, num_heads=3,
+                  num_classes=num_classes, num_experts=8, dtype=dtype)

@@ -45,6 +45,8 @@ _LAZY = {
     "from_pipeline_params": "pipeline",
     "make_pp_train_step": "pipeline",
     "to_pipeline_params": "pipeline",
+    "MOE_EP_RULES": "expert_parallel",
+    "make_ep_train_step": "expert_parallel",
 }
 
 
@@ -87,4 +89,6 @@ __all__ = [
     "from_pipeline_params",
     "make_pp_train_step",
     "to_pipeline_params",
+    "MOE_EP_RULES",
+    "make_ep_train_step",
 ]

@@ -1,0 +1,67 @@
+"""Expert parallelism (EP) — sharding routed-MoE experts over a mesh axis.
+
+Absent from the reference (SURVEY.md §2.3: "Expert parallel (EP / MoE): NO");
+built TPU-first on GSPMD: the stacked expert weights of
+``tpu_ddp.models.moe.MoEMlp`` (``w_up (E, C, H)`` etc.) are annotated
+``P('expert', ...)`` and the XLA partitioner turns the dispatch/combine
+einsums into the token all-to-all over ICI — no hand-written
+``lax.all_to_all``, and the expert FFN matmuls each device runs are the
+large dense (E/ep)-expert blocks the MXU wants.
+
+EP composes with DP on a 2-D ``data x expert`` mesh (batch sharded over
+``data``, experts over ``expert``) and with TP by concatenating
+``VIT_TP_RULES`` — the step itself is ``make_sharded_train_step``, the same
+rule-agnostic GSPMD builder TP and FSDP use; only the layout rules differ.
+The MoE load-balance aux loss (sown into the ``aux_loss`` collection) is
+handled by that builder's ``aux_weight`` path, mirroring the Switch recipe.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_ddp.parallel.mesh import DATA_AXIS, EXPERT_AXIS
+from tpu_ddp.parallel.partitioning import PartitionRule, specs_for_params
+from tpu_ddp.train.losses import cross_entropy_loss
+from tpu_ddp.train.state import TrainState
+
+# Layout for tpu_ddp.models.moe.MoEMlp (paths like block_1/moe/w_up).
+# Router weights stay replicated: every device routes its own tokens.
+MOE_EP_RULES = (
+    PartitionRule(r"moe/w_up$", P(EXPERT_AXIS, None, None)),
+    PartitionRule(r"moe/b_up$", P(EXPERT_AXIS, None)),
+    PartitionRule(r"moe/w_down$", P(EXPERT_AXIS, None, None)),
+    PartitionRule(r"moe/b_down$", P(EXPERT_AXIS, None)),
+)
+
+
+def make_ep_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    state_template: TrainState,
+    *,
+    rules=MOE_EP_RULES,
+    aux_weight: float = 0.01,
+    data_axis: str = DATA_AXIS,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+):
+    """Expert-parallel (optionally DP x EP) MoE train step.
+
+    Returns ``(step, state_shardings)``; lay the initial state out with
+    ``shard_train_state``. ``metrics`` carries both the task loss and the
+    load-balance aux loss so balance collapse is observable.
+    """
+    from tpu_ddp.parallel.tensor_parallel import make_sharded_train_step
+
+    param_specs = specs_for_params(state_template.params, rules)
+    build = make_sharded_train_step(
+        model, tx, mesh, param_specs,
+        data_axis=data_axis, loss_fn=loss_fn, donate=donate,
+        aux_weight=aux_weight,
+    )
+    return build(state_template)

@@ -19,12 +19,13 @@ ZeRO-3). Same step code covers all of them — that's the point of GSPMD.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Optional
 
 import jax
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_ddp.train.losses import combine_aux_loss
 
 from tpu_ddp.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from tpu_ddp.parallel.partitioning import (
@@ -59,10 +60,16 @@ def make_sharded_train_step(
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
     has_batch_stats: bool = False,
+    aux_weight: float = 0.01,
 ):
     """GSPMD train step: params laid out by `param_specs`, batch sharded over
     `data_axis`; gradient averaging over the data axis and every TP collective
     are inserted by the partitioner.
+
+    Losses sown by the model into the ``aux_loss`` collection (the MoE
+    load-balance term) join the differentiated loss with weight
+    ``aux_weight`` and surface as ``metrics['aux_loss']``; the reported
+    ``loss`` stays the task loss.
 
     Returns a builder: call ``build(state_template)`` to get
     ``(step, state_shardings)``; lay the initial state out with
@@ -72,24 +79,27 @@ def make_sharded_train_step(
 
     def compute_loss(params, batch_stats, batch):
         variables = {"params": params}
+        mutable = ["aux_loss"]
         if has_batch_stats:
             variables["batch_stats"] = batch_stats
-            logits, mutated = model.apply(
-                variables, batch["image"], train=True, mutable=["batch_stats"]
-            )
-            new_stats = mutated["batch_stats"]
-        else:
-            logits = model.apply(variables, batch["image"], train=True)
-            new_stats = batch_stats
-        loss = loss_fn(logits, batch["label"], batch.get("mask"))
-        return loss, new_stats
+            mutable.append("batch_stats")
+        logits, mutated = model.apply(
+            variables, batch["image"], train=True, mutable=mutable
+        )
+        new_stats = mutated.get("batch_stats", batch_stats)
+        task = loss_fn(logits, batch["label"], batch.get("mask"))
+        loss, aux = combine_aux_loss(task, mutated, aux_weight)
+        return loss, (new_stats, task, aux)
 
     def step_fn(state: TrainState, batch):
-        (loss, new_stats), grads = jax.value_and_grad(
+        (_, (new_stats, task, aux)), grads = jax.value_and_grad(
             compute_loss, has_aux=True
         )(state.params, state.batch_stats, batch)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": task}
+        if aux is not None:
+            metrics["aux_loss"] = aux
         return (
             state.replace(
                 step=state.step + 1,
@@ -97,7 +107,7 @@ def make_sharded_train_step(
                 batch_stats=new_stats,
                 opt_state=new_opt_state,
             ),
-            {"loss": loss},
+            metrics,
         )
 
     # One builder serves any state_template: shardings are computed from the
@@ -132,6 +142,7 @@ def make_tp_train_step(
     data_axis: str = DATA_AXIS,
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
+    aux_weight: float = 0.01,
 ):
     """Tensor-parallel (optionally DP x TP on a 2-D mesh) ViT train step.
 
@@ -140,6 +151,7 @@ def make_tp_train_step(
     build = make_sharded_train_step(
         model, tx, mesh, param_specs,
         data_axis=data_axis, loss_fn=loss_fn, donate=donate,
+        aux_weight=aux_weight,
     )
     return build(state_template)
 
@@ -155,6 +167,7 @@ def make_fsdp_train_step(
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
     has_batch_stats: bool = False,
+    aux_weight: float = 0.01,
 ):
     """ZeRO-3/FSDP step: params + optimizer state scattered over `shard_axis`
     (each device stores 1/N of every big tensor; XLA all-gathers params for
@@ -166,5 +179,6 @@ def make_fsdp_train_step(
         model, tx, mesh, param_specs,
         data_axis=data_axis, loss_fn=loss_fn, donate=donate,
         has_batch_stats=has_batch_stats,
+        aux_weight=aux_weight,
     )
     return build(state_template)

@@ -35,6 +35,21 @@ def binary_cross_entropy_with_logits(logits, targets, mask: Optional[jnp.ndarray
     return (per * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
 
+def combine_aux_loss(task, mutated: dict, aux_weight: float):
+    """Fold model-sown auxiliary losses (the ``aux_loss`` collection — e.g.
+    the MoE router's load-balance term, ``models.moe.MoEMlp``) into the
+    differentiated objective: ``(total, aux)`` where ``aux`` is None when the
+    model sowed nothing. Shared by every train-step builder so aux semantics
+    can't drift between the shard_map and GSPMD paths."""
+    leaves = jax.tree.leaves(mutated.get("aux_loss", {}))
+    if not leaves:
+        return task, None
+    aux = leaves[0]
+    for leaf in leaves[1:]:
+        aux = aux + leaf
+    return task + aux_weight * aux, aux
+
+
 def masked_accuracy(logits, labels, mask: Optional[jnp.ndarray] = None):
     """(correct_count, valid_count) — summable across shards/batches. The
     eval metric the reference never computes (SURVEY.md §6)."""

@@ -39,7 +39,11 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_ddp.parallel.mesh import DATA_AXIS
-from tpu_ddp.train.losses import cross_entropy_loss, masked_accuracy
+from tpu_ddp.train.losses import (
+    combine_aux_loss,
+    cross_entropy_loss,
+    masked_accuracy,
+)
 from tpu_ddp.train.state import TrainState
 
 Batch = dict
@@ -55,16 +59,24 @@ def _make_shard_step(
     remat: bool = False,
     augment: bool = False,
     augment_seed: int = 0,
+    aux_weight: float = 0.01,
 ):
     """Per-shard train-step body shared by the single-step and scanned
-    variants: forward, pmean'd loss (the gradient allreduce), optax update."""
+    variants: forward, pmean'd loss (the gradient allreduce), optax update.
+
+    Models that sow auxiliary losses into the ``aux_loss`` collection (the
+    MoE router's load-balance term, ``models.moe.MoEMlp``) get them added to
+    the differentiated loss with weight ``aux_weight`` — so a routed-MoE
+    model picked from the zoo trains correctly through this generic step,
+    not only through ``make_ep_train_step``. Reported ``loss`` stays the
+    task loss; the aux term appears as its own metric when present."""
 
     def apply_model(params, batch_stats, images):
         return model.apply(
             {"params": params, "batch_stats": batch_stats},
             images,
             train=True,
-            mutable=["batch_stats"],
+            mutable=["batch_stats", "aux_loss"],
         )
 
     if remat:
@@ -72,7 +84,8 @@ def _make_shard_step(
 
     def compute_loss(params, batch_stats, batch):
         logits, mutated = apply_model(params, batch_stats, batch["image"])
-        loss = loss_fn(logits, batch["label"], batch.get("mask"))
+        task = loss_fn(logits, batch["label"], batch.get("mask"))
+        loss, aux = combine_aux_loss(task, mutated, aux_weight)
         # Gradient sync lives HERE: pmean-ing the per-shard loss before
         # differentiation makes reverse-mode AD produce the globally
         # *averaged* gradient — the pmean's transpose scatters cotangent
@@ -83,7 +96,7 @@ def _make_shard_step(
         # collective visible to XLA for backward/comm overlap. (An explicit
         # post-hoc pmean on grads would DOUBLE-count: AD has already summed.)
         loss = lax.pmean(loss, data_axis)
-        return loss, (mutated["batch_stats"], logits)
+        return loss, (mutated.get("batch_stats", batch_stats), logits, task, aux)
 
     def shard_step(state: TrainState, batch: Batch):
         if augment:
@@ -93,7 +106,7 @@ def _make_shard_step(
             key = jax.random.fold_in(key, lax.axis_index(data_axis))
             batch = dict(batch, image=random_crop_flip(key, batch["image"]))
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
-        (loss, (new_stats, logits)), grads = grad_fn(
+        (_, (new_stats, logits, task, aux)), grads = grad_fn(
             state.params, state.batch_stats, batch
         )
         new_stats = jax.tree.map(lambda s: lax.pmean(s, data_axis), new_stats)
@@ -105,7 +118,9 @@ def _make_shard_step(
             batch_stats=new_stats,
             opt_state=new_opt_state,
         )
-        metrics = {"loss": loss}
+        metrics = {"loss": lax.pmean(task, data_axis)}
+        if aux is not None:
+            metrics["aux_loss"] = lax.pmean(aux, data_axis)
         if compute_accuracy:
             correct, count = masked_accuracy(
                 logits, batch["label"], batch.get("mask")
@@ -130,6 +145,7 @@ def make_train_step(
     remat: bool = False,
     augment: bool = False,
     augment_seed: int = 0,
+    aux_weight: float = 0.01,
 ) -> Callable[[TrainState, Batch], tuple]:
     """Build the compiled DDP train step for `mesh`.
 
@@ -151,6 +167,7 @@ def make_train_step(
         remat=remat,
         augment=augment,
         augment_seed=augment_seed,
+        aux_weight=aux_weight,
     )
     sharded = jax.shard_map(
         shard_step,
@@ -174,6 +191,7 @@ def make_scan_train_step(
     remat: bool = False,
     augment: bool = False,
     augment_seed: int = 0,
+    aux_weight: float = 0.01,
 ) -> Callable[[TrainState, Batch], tuple]:
     """K train steps fused into ONE dispatch via ``lax.scan``.
 
@@ -198,6 +216,7 @@ def make_scan_train_step(
         remat=remat,
         augment=augment,
         augment_seed=augment_seed,
+        aux_weight=aux_weight,
     )
 
     def shard_multi(state: TrainState, batches: Batch):
