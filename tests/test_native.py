@@ -52,3 +52,95 @@ def test_gather_rows_large_uses_native_and_matches():
     src = rng.normal(size=(64, 32, 32, 3)).astype(np.float32)
     idx = rng.integers(0, 64, size=512)  # 512*3072*4B = 6MB
     np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+
+
+def _roundtrip_prefetcher(ring_cls):
+    from tpu_ddp.native import prefetch as pf_mod
+
+    rng = np.random.default_rng(4)
+    images = rng.normal(size=(40, 8, 8, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, size=40).astype(np.int64)
+    ring = ring_cls(images, labels, 16, 3)
+    schedules = [rng.integers(0, 40, size=16) for _ in range(7)]
+    # pipeline: keep up to 3 in flight, FIFO order must hold throughout
+    out = []
+    in_flight = 0
+    it = iter(schedules)
+    submitted = []
+    for idx in it:
+        ring.submit(idx)
+        submitted.append(idx)
+        in_flight += 1
+        if in_flight == 3:
+            img, lbl, slot = ring.acquire()
+            out.append((img.copy(), lbl.copy()))
+            ring.release(slot)
+            in_flight -= 1
+    while in_flight:
+        img, lbl, slot = ring.acquire()
+        out.append((img.copy(), lbl.copy()))
+        ring.release(slot)
+        in_flight -= 1
+    ring.close()
+    for (img, lbl), idx in zip(out, submitted):
+        np.testing.assert_array_equal(img, images[idx])
+        np.testing.assert_array_equal(lbl, labels[idx])
+
+
+def test_native_prefetcher_ring_fifo_parity():
+    from tpu_ddp.native.prefetch import _NativeRing
+
+    assert native.AVAILABLE
+    _roundtrip_prefetcher(_NativeRing)
+
+
+def test_thread_fallback_prefetcher_parity():
+    from tpu_ddp.native.prefetch import _ThreadRing
+
+    _roundtrip_prefetcher(_ThreadRing)
+
+
+def test_native_prefetcher_rejects_bad_indices():
+    """The C++ gather is unvalidated memcpy; the Python face must raise
+    (like numpy fancy indexing) before anything reaches it."""
+    from tpu_ddp.native.prefetch import _NativeRing
+
+    images = np.zeros((10, 2, 2, 3), np.float32)
+    labels = np.zeros(10, np.int64)
+    ring = _NativeRing(images, labels, 4, 2)
+    with pytest.raises(IndexError):
+        ring.submit(np.array([0, 10]))
+    with pytest.raises(IndexError):
+        ring.submit(np.array([-1, 0]))
+    with pytest.raises(ValueError):
+        ring.submit(np.arange(5))  # exceeds slot capacity
+    ring.close()
+
+
+def test_thread_fallback_surfaces_worker_errors():
+    """A gather error in the worker must raise from acquire(), not hang."""
+    from tpu_ddp.native.prefetch import _ThreadRing
+
+    images = np.zeros((10, 2, 2, 3), np.float32)
+    labels = np.zeros(10, np.int64)
+    ring = _ThreadRing(images, labels, 4, 2)
+    ring.submit(np.array([0, 99]))  # OOB -> numpy IndexError in the worker
+    with pytest.raises(IndexError):
+        ring.acquire()
+    ring.close()
+
+
+def test_prefetcher_multihot_float_labels():
+    """bce-style (N, C) float32 targets ride the byte-row gather too."""
+    from tpu_ddp.native.prefetch import BatchPrefetcher
+
+    rng = np.random.default_rng(5)
+    images = rng.normal(size=(30, 4, 4, 3)).astype(np.float32)
+    labels = (rng.random((30, 3)) < 0.5).astype(np.float32)
+    with BatchPrefetcher(images, labels, max_batch=8, depth=2) as pf:
+        idx = rng.integers(0, 30, size=8)
+        pf.submit(idx)
+        img, lbl, slot = pf.acquire()
+        np.testing.assert_array_equal(img, images[idx])
+        np.testing.assert_array_equal(lbl, labels[idx])
+        pf.release(slot)

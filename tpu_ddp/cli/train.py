@@ -87,6 +87,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help=">1 fuses K optimizer steps into one dispatch "
                         "(lax.scan) — amortizes host overhead on small "
                         "models; semantics unchanged")
+    p.add_argument("--prefetch-depth", type=int, default=2,
+                   help="batches assembled ahead on the native host "
+                        "prefetcher (C++ ring buffer; 0 disables)")
     return p
 
 
@@ -142,6 +145,7 @@ def config_from_args(args) -> TrainConfig:
         dump_predictions=args.dump_predictions,
         synthetic_size=args.synthetic_size,
         steps_per_call=args.steps_per_call,
+        prefetch_depth=args.prefetch_depth,
     )
 
 

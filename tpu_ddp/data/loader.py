@@ -112,7 +112,11 @@ class ShardedBatchLoader:
         """The fix for the reference's missing ``sampler.set_epoch`` call."""
         self._epoch = epoch
 
-    def epoch_batches(self, epoch: Optional[int] = None) -> Iterator[Dict[str, np.ndarray]]:
+    def epoch_index_batches(
+        self, epoch: Optional[int] = None
+    ) -> Iterator[tuple]:
+        """Yield (idx, mask) per step — the sampler half of the loader,
+        separated so a prefetcher can pipeline the gather half."""
         epoch = self._epoch if epoch is None else epoch
         eff_epoch = epoch if self.reshuffle_each_epoch else 0
         shards = shard_indices(
@@ -144,10 +148,14 @@ class ShardedBatchLoader:
             mask[:, :valid] = True
             if self.exclude_sampler_pad:
                 mask[:, :valid] &= real
+            yield idx, mask.reshape(-1)
+
+    def epoch_batches(self, epoch: Optional[int] = None) -> Iterator[Dict[str, np.ndarray]]:
+        for idx, mask in self.epoch_index_batches(epoch):
             yield {
                 "image": _gather(self.images, idx),
                 "label": _gather(self.labels, idx),
-                "mask": mask.reshape(-1),
+                "mask": mask,
             }
 
     def __iter__(self):

@@ -19,7 +19,12 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
-_SRC = os.path.join(os.path.dirname(__file__), "cifar_codec.cpp")
+_SRCS = [
+    os.path.join(os.path.dirname(__file__), "cifar_codec.cpp"),
+    os.path.join(os.path.dirname(__file__), "prefetcher.cpp"),
+]
+# headers count toward staleness, not toward the compile line
+_HDRS = [os.path.join(os.path.dirname(__file__), "parallel_for.h")]
 _LIB_NAME = "libcifar_codec.so"
 
 AVAILABLE = False
@@ -56,8 +61,9 @@ def _build_and_load():
         os.path.join(os.path.dirname(__file__), _LIB_NAME),
         os.path.join(cache, _LIB_NAME),
     ]
+    src_mtime = max(os.path.getmtime(s) for s in _SRCS + _HDRS)
     for path in candidates:
-        if os.path.exists(path) and os.path.getmtime(path) >= os.path.getmtime(_SRC):
+        if os.path.exists(path) and os.path.getmtime(path) >= src_mtime:
             try:
                 _lib = ctypes.CDLL(path)
                 break
@@ -70,7 +76,7 @@ def _build_and_load():
         tmp_out = f"{out}.{os.getpid()}.tmp"
         cmd = [
             "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-            "-o", tmp_out, _SRC, "-lpthread",
+            "-o", tmp_out, *_SRCS, "-lpthread",
         ]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -94,8 +100,25 @@ def _build_and_load():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_int64, ctypes.c_int64,
         ]
+        _lib.bp_create.restype = ctypes.c_void_p
+        _lib.bp_create.argtypes = [
+            ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+        ]
+        _lib.bp_submit.restype = ctypes.c_int
+        _lib.bp_submit.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ]
+        _lib.bp_acquire.restype = ctypes.c_int
+        _lib.bp_acquire.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
+        _lib.bp_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        _lib.bp_destroy.argtypes = [ctypes.c_void_p]
         _lib.cifar_codec_abi_version.restype = ctypes.c_int
-        if _lib.cifar_codec_abi_version() != 1:
+        if _lib.cifar_codec_abi_version() != 2:
             raise RuntimeError("cifar_codec ABI version mismatch")
     except Exception as e:
         log.warning("native cifar_codec unusable (%s); numpy fallback", e)

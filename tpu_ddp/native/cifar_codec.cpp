@@ -17,31 +17,9 @@
 #include <thread>
 #include <vector>
 
-namespace {
+#include "parallel_for.h"
 
-// Spread [0, n) across up to `max_threads` workers.
-template <typename F>
-void parallel_for(int64_t n, F&& fn) {
-  unsigned hw = std::thread::hardware_concurrency();
-  int64_t n_threads = hw ? static_cast<int64_t>(hw) : 4;
-  if (n_threads > n) n_threads = n > 0 ? n : 1;
-  if (n_threads <= 1) {
-    fn(0, n);
-    return;
-  }
-  std::vector<std::thread> workers;
-  workers.reserve(n_threads);
-  int64_t chunk = (n + n_threads - 1) / n_threads;
-  for (int64_t t = 0; t < n_threads; ++t) {
-    int64_t lo = t * chunk;
-    int64_t hi = lo + chunk < n ? lo + chunk : n;
-    if (lo >= hi) break;
-    workers.emplace_back([=, &fn] { fn(lo, hi); });
-  }
-  for (auto& w : workers) w.join();
-}
-
-}  // namespace
+using tpu_ddp_native::parallel_for;
 
 extern "C" {
 
@@ -91,6 +69,7 @@ void gather_rows_i32(const int32_t* src, const int64_t* idx, int32_t* dst,
   });
 }
 
-int cifar_codec_abi_version() { return 1; }
+// v2: + batch prefetcher (prefetcher.cpp, bp_* entry points)
+int cifar_codec_abi_version() { return 2; }
 
 }  // extern "C"

@@ -1,0 +1,189 @@
+"""Batch prefetcher: background host-side batch assembly.
+
+The native path (``prefetcher.cpp``) keeps a ring of C++-owned slot buffers
+filled by a worker thread (multithreaded row gather from the in-memory
+dataset), so assembling batch N+depth overlaps the device computing batch N —
+the single-process SPMD answer to the reference's DataLoader worker
+processes (SURVEY.md §2.6). The fallback is a Python thread doing the same
+gathers; either way the interface and FIFO semantics are identical.
+
+Consumption contract: views returned by ``acquire()`` alias reusable slot
+memory — they are valid ONLY until ``release(slot)``. ``jax.device_put`` is
+NOT a copy barrier (the CPU backend can alias the host buffer zero-copy,
+and PJRT transfers may complete asynchronously): release a slot only after
+``jax.block_until_ready`` on the device arrays, or after an explicit
+``np.copy``. ``Trainer._prefetched_stream`` is the reference consumer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from tpu_ddp import native
+
+
+class _NativeRing:
+    """ctypes face of the C++ prefetcher; created only when the native
+    library is live."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 max_batch: int, depth: int):
+        self.images = np.ascontiguousarray(images)
+        self.labels = np.ascontiguousarray(labels)
+        self.img_row = int(np.prod(self.images.shape[1:], dtype=np.int64)
+                           ) * self.images.itemsize
+        self.lbl_row = (
+            int(np.prod(self.labels.shape[1:], dtype=np.int64))
+            * self.labels.itemsize
+            if self.labels.ndim > 1
+            else self.labels.itemsize
+        )
+        self.max_batch = max_batch
+        self._h = native._lib.bp_create(
+            depth, max_batch * self.img_row, max_batch * self.lbl_row
+        )
+        if not self._h:
+            raise RuntimeError("bp_create failed")
+        self._batch_sizes: "queue.Queue[int]" = queue.Queue()
+
+    def submit(self, idx: np.ndarray) -> None:
+        idx64 = np.ascontiguousarray(idx, np.int64)
+        if idx64.size > self.max_batch:
+            raise ValueError(
+                f"batch of {idx64.size} exceeds slot capacity {self.max_batch}"
+            )
+        # The C++ gather memcpy's unvalidated src + idx*row_bytes: bound the
+        # indices HERE so a sampler bug raises like numpy fancy indexing
+        # would, instead of reading out-of-bounds heap in the worker thread.
+        if idx64.size and (
+            int(idx64.min()) < 0 or int(idx64.max()) >= len(self.images)
+        ):
+            raise IndexError(
+                f"prefetch indices out of range [0, {len(self.images)})"
+            )
+        rc = native._lib.bp_submit(
+            self._h,
+            self.images.ctypes.data, self.labels.ctypes.data,
+            idx64.ctypes.data, idx64.size, self.img_row, self.lbl_row,
+        )
+        if rc < 0:
+            raise RuntimeError(f"bp_submit failed ({rc})")
+        self._batch_sizes.put(idx64.size)
+
+    def acquire(self) -> Tuple[np.ndarray, np.ndarray, int]:
+        n = self._batch_sizes.get()
+        img_p = ctypes.c_void_p()
+        lbl_p = ctypes.c_void_p()
+        slot = native._lib.bp_acquire(
+            self._h, ctypes.byref(img_p), ctypes.byref(lbl_p)
+        )
+        if slot < 0:
+            raise RuntimeError("bp_acquire on a stopping prefetcher")
+        img_shape = (n,) + self.images.shape[1:]
+        lbl_shape = (n,) + self.labels.shape[1:]
+        img = np.ctypeslib.as_array(
+            ctypes.cast(img_p, ctypes.POINTER(ctypes.c_uint8)),
+            shape=(n * self.img_row,),
+        ).view(self.images.dtype).reshape(img_shape)
+        lbl = np.ctypeslib.as_array(
+            ctypes.cast(lbl_p, ctypes.POINTER(ctypes.c_uint8)),
+            shape=(n * self.lbl_row,),
+        ).view(self.labels.dtype).reshape(lbl_shape)
+        return img, lbl, slot
+
+    def release(self, slot: int) -> None:
+        native._lib.bp_release(self._h, slot)
+
+    def close(self) -> None:
+        if self._h:
+            native._lib.bp_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _ThreadRing:
+    """Pure-Python fallback: one worker thread gathering into fresh arrays
+    (no slot reuse, so release is a no-op)."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 max_batch: int, depth: int):
+        self.images, self.labels = images, labels
+        self._jobs: "queue.Queue" = queue.Queue()
+        self._out: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            idx = self._jobs.get()
+            if idx is None:
+                return
+            try:
+                self._out.put(
+                    (native.gather_rows(self.images, idx),
+                     native.gather_rows(self.labels, idx))
+                )
+            except BaseException as e:  # surface in acquire(), don't hang it
+                self._out.put(e)
+
+    def submit(self, idx: np.ndarray) -> None:
+        self._jobs.put(np.ascontiguousarray(idx, np.int64))
+
+    def acquire(self) -> Tuple[np.ndarray, np.ndarray, int]:
+        got = self._out.get()
+        if isinstance(got, BaseException):
+            raise got
+        img, lbl = got
+        return img, lbl, -1
+
+    def release(self, slot: int) -> None:
+        pass
+
+    def close(self) -> None:
+        self._jobs.put(None)
+
+
+class BatchPrefetcher:
+    """FIFO prefetcher over an in-memory dataset.
+
+    ``submit(idx)`` enqueues a gather of rows ``idx``; ``acquire()`` returns
+    ``(images, labels, slot)`` for the oldest submission. Backed by the
+    native ring when ``tpu_ddp.native.AVAILABLE``, else a Python thread.
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray, *,
+                 max_batch: int, depth: int = 3):
+        impl = _NativeRing if native.AVAILABLE else _ThreadRing
+        self._ring = impl(images, labels, max_batch, depth)
+        # True when acquire() returns views of reusable slot memory (the
+        # native ring); the thread fallback hands out fresh arrays.
+        self.reusable_slots = impl is _NativeRing
+
+    def submit(self, idx: np.ndarray) -> None:
+        self._ring.submit(idx)
+
+    def acquire(self) -> Tuple[np.ndarray, np.ndarray, int]:
+        return self._ring.acquire()
+
+    def release(self, slot: int) -> None:
+        self._ring.release(slot)
+
+    def close(self) -> None:
+        self._ring.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,191 @@
+// Native host-side batch prefetcher for tpu_ddp.
+//
+// The reference's input pipeline is torch's DataLoader with worker
+// processes (SURVEY.md §2.6: torch.utils.data native machinery). This is
+// the in-tree native equivalent shaped for the SPMD world: ONE process
+// feeds all devices, so instead of worker *processes* + IPC we run a
+// background thread that assembles whole global batches (multithreaded row
+// gather from the in-memory dataset) into a ring of reusable slot buffers,
+// overlapping host batch assembly with device compute.
+//
+// Contract (enforced on the Python side, tpu_ddp/native/prefetch.py):
+//   submit(idx) -> blocks for a free slot, enqueues a gather job
+//   acquire()   -> blocks for the next filled slot, FIFO with submits
+//   release(id) -> slot becomes reusable; callers release only after
+//                  jax.device_put has copied the views out
+//
+// Rows are opaque bytes (img/lbl row sizes in bytes), so any dtype works.
+//
+// Built into libcifar_codec.so alongside cifar_codec.cpp; C ABI for ctypes.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+#include "parallel_for.h"
+
+namespace {
+
+using tpu_ddp_native::parallel_for;
+
+struct Job {
+  const uint8_t* img_src;
+  const uint8_t* lbl_src;
+  std::vector<int64_t> idx;
+  int64_t img_row_bytes;
+  int64_t lbl_row_bytes;
+  int slot;
+};
+
+struct Prefetcher {
+  int n_slots;
+  int64_t img_capacity;  // bytes per slot
+  int64_t lbl_capacity;
+  std::vector<std::unique_ptr<uint8_t[]>> img_bufs;
+  std::vector<std::unique_ptr<uint8_t[]>> lbl_bufs;
+
+  std::mutex m;
+  std::condition_variable cv_job;   // worker waits for jobs
+  std::condition_variable cv_done;  // acquire waits for filled slots
+  std::condition_variable cv_free;  // submit waits for free slots
+  std::queue<Job> jobs;
+  std::queue<int> done;             // filled slots, FIFO with submits
+  std::vector<int> free_slots;
+  bool stopping = false;
+  std::thread worker;
+
+  explicit Prefetcher(int slots, int64_t img_cap, int64_t lbl_cap)
+      : n_slots(slots), img_capacity(img_cap), lbl_capacity(lbl_cap) {
+    for (int s = 0; s < n_slots; ++s) {
+      img_bufs.emplace_back(new uint8_t[static_cast<size_t>(img_cap)]);
+      lbl_bufs.emplace_back(new uint8_t[static_cast<size_t>(lbl_cap)]);
+      free_slots.push_back(s);
+    }
+    worker = std::thread([this] { run(); });
+  }
+
+  ~Prefetcher() {
+    {
+      std::lock_guard<std::mutex> lk(m);
+      stopping = true;
+    }
+    cv_job.notify_all();
+    cv_done.notify_all();
+    cv_free.notify_all();
+    worker.join();
+  }
+
+  void run() {
+    for (;;) {
+      Job job;
+      {
+        std::unique_lock<std::mutex> lk(m);
+        cv_job.wait(lk, [&] { return stopping || !jobs.empty(); });
+        if (stopping) return;
+        job = std::move(jobs.front());
+        jobs.pop();
+      }
+      uint8_t* img_dst = img_bufs[job.slot].get();
+      uint8_t* lbl_dst = lbl_bufs[job.slot].get();
+      const int64_t n = static_cast<int64_t>(job.idx.size());
+      const int64_t irb = job.img_row_bytes;
+      const int64_t lrb = job.lbl_row_bytes;
+      const int64_t* idx = job.idx.data();
+      parallel_for(n, [&](int64_t lo, int64_t hi) {
+        for (int64_t j = lo; j < hi; ++j) {
+          std::memcpy(img_dst + j * irb, job.img_src + idx[j] * irb,
+                      static_cast<size_t>(irb));
+          std::memcpy(lbl_dst + j * lrb, job.lbl_src + idx[j] * lrb,
+                      static_cast<size_t>(lrb));
+        }
+      });
+      {
+        std::lock_guard<std::mutex> lk(m);
+        done.push(job.slot);
+      }
+      cv_done.notify_one();
+    }
+  }
+
+  int submit(const uint8_t* img_src, const uint8_t* lbl_src,
+             const int64_t* idx, int64_t n_idx, int64_t img_row_bytes,
+             int64_t lbl_row_bytes) {
+    if (n_idx * img_row_bytes > img_capacity ||
+        n_idx * lbl_row_bytes > lbl_capacity) {
+      return -2;  // batch larger than the slot buffers
+    }
+    int slot;
+    {
+      std::unique_lock<std::mutex> lk(m);
+      cv_free.wait(lk, [&] { return stopping || !free_slots.empty(); });
+      if (stopping) return -1;
+      slot = free_slots.back();
+      free_slots.pop_back();
+      Job job;
+      job.img_src = img_src;
+      job.lbl_src = lbl_src;
+      job.idx.assign(idx, idx + n_idx);
+      job.img_row_bytes = img_row_bytes;
+      job.lbl_row_bytes = lbl_row_bytes;
+      job.slot = slot;
+      jobs.push(std::move(job));
+    }
+    cv_job.notify_one();
+    return slot;
+  }
+
+  int acquire(void** img, void** lbl) {
+    std::unique_lock<std::mutex> lk(m);
+    cv_done.wait(lk, [&] { return stopping || !done.empty(); });
+    if (done.empty()) return -1;  // stopping with nothing filled
+    int slot = done.front();
+    done.pop();
+    *img = img_bufs[slot].get();
+    *lbl = lbl_bufs[slot].get();
+    return slot;
+  }
+
+  void release(int slot) {
+    {
+      std::lock_guard<std::mutex> lk(m);
+      free_slots.push_back(slot);
+    }
+    cv_free.notify_one();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* bp_create(int n_slots, int64_t img_capacity_bytes,
+                int64_t lbl_capacity_bytes) {
+  if (n_slots < 1) return nullptr;
+  return new Prefetcher(n_slots, img_capacity_bytes, lbl_capacity_bytes);
+}
+
+int bp_submit(void* h, const void* img_src, const void* lbl_src,
+              const int64_t* idx, int64_t n_idx, int64_t img_row_bytes,
+              int64_t lbl_row_bytes) {
+  return static_cast<Prefetcher*>(h)->submit(
+      static_cast<const uint8_t*>(img_src),
+      static_cast<const uint8_t*>(lbl_src), idx, n_idx, img_row_bytes,
+      lbl_row_bytes);
+}
+
+int bp_acquire(void* h, void** img, void** lbl) {
+  return static_cast<Prefetcher*>(h)->acquire(img, lbl);
+}
+
+void bp_release(void* h, int slot) {
+  static_cast<Prefetcher*>(h)->release(slot);
+}
+
+void bp_destroy(void* h) { delete static_cast<Prefetcher*>(h); }
+
+}  // extern "C"

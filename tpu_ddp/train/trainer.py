@@ -56,6 +56,9 @@ class TrainConfig:
     steps_per_call: int = 1               # >1: fuse K optimizer steps into
                                           # one dispatch (lax.scan) — hides
                                           # host overhead on small models
+    prefetch_depth: int = 2               # >0: assemble batches ahead on the
+                                          # native host prefetcher (C++ ring
+                                          # buffer; 0 disables)
     remat: bool = False                   # jax.checkpoint the forward:
                                           # trade FLOPs for HBM on big models
     model: str = "netresdeep"
@@ -184,6 +187,7 @@ class Trainer:
             self.model, self.mesh, loss_fn=loss_fn, compute_accuracy=with_acc
         )
         self.predict_step = None  # built lazily in predict()
+        self._prefetcher = None   # built lazily on first epoch
         self.history: dict = {"epoch": [], "train_loss": []}
         self.logger = MetricLogger(jsonl_path=config.jsonl_path)
 
@@ -247,13 +251,133 @@ class Trainer:
     def _put(self, batch):
         return jax.device_put(batch, self.batch_sharding)
 
-    def _put_stacked(self, batches):
-        """Stack K host batches on a new leading (scan) axis for the fused
-        multi-step; batch axis stays sharded over the mesh."""
-        stacked = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
-        return jax.device_put(stacked, self.stacked_sharding)
+    def _epoch_stream(self):
+        """Yield ``(kind, device_batch, n_real)``: kind is "stacked" for
+        fused K-step groups (arrays carry a leading (K,) scan axis) and
+        "single" for lone steps — the epoch remainder smaller than
+        steps_per_call runs as plain steps so the scan's stacked shapes stay
+        static. Batches come back already device_put with the right
+        sharding; ``n_real`` is the host-side count of unmasked samples (so
+        throughput accounting never forces a device sync).
+
+        With ``prefetch_depth > 0`` batches assemble ahead of consumption on
+        the host prefetcher (native C++ ring when available)."""
+        K = self.steps_per_call if self.multi_step is not None else 1
+        depth = self.config.prefetch_depth
+        if depth > 0:
+            if self._prefetcher is None:
+                from tpu_ddp.native.prefetch import BatchPrefetcher
+
+                self._prefetcher = BatchPrefetcher(
+                    self.train_loader.images,
+                    self.train_loader.labels,
+                    max_batch=K * self.train_loader.global_batch,
+                    depth=depth + 1,
+                )
+            yield from self._prefetched_stream(K, depth)
+            return
+        if K <= 1:
+            for batch in self.train_loader:
+                yield "single", self._put(batch), int(batch["mask"].sum())
+            return
+        pending = []
+        for batch in self.train_loader:
+            pending.append(batch)
+            if len(pending) == K:
+                stacked = {
+                    k: np.stack([b[k] for b in pending]) for k in pending[0]
+                }
+                yield (
+                    "stacked",
+                    jax.device_put(stacked, self.stacked_sharding),
+                    int(stacked["mask"].sum()),
+                )
+                pending = []
+        for batch in pending:
+            yield "single", self._put(batch), int(batch["mask"].sum())
+
+    def _prefetched_stream(self, K: int, depth: int):
+        """Prefetcher-backed _epoch_stream body. A fused K-step group is ONE
+        submission (concatenated indices -> one native gather whose output
+        IS the stacked (K*B, ...) layout) — no host-side np.stack at all.
+
+        Slot lifetime: the gathered views alias reusable native buffers. On
+        TPU, ``device_put`` + ``block_until_ready`` is a real H2D copy, so
+        the slot recycles right after the fence. On the CPU backend,
+        ``device_put`` zero-copy ALIASES 64-byte-aligned numpy inputs — and
+        ignores ``may_alias=False`` (verified empirically) — so the views
+        are np.copy'd first; without this, slot reuse corrupts batches the
+        compiled step hasn't consumed yet, nondeterministically (it depends
+        on the C++ heap handing back 64-aligned slots)."""
+        from collections import deque
+
+        pf = self._prefetcher
+        loader = self.train_loader
+        img_tail = loader.images.shape[1:]
+        lbl_tail = loader.labels.shape[1:]
+        host_copy = pf.reusable_slots and jax.default_backend() == "cpu"
+
+        def submissions():
+            buf_idx, buf_masks = [], []
+            for idx, mask in loader.epoch_index_batches():
+                if K <= 1:
+                    yield "single", idx, mask
+                    continue
+                buf_idx.append(idx)
+                buf_masks.append(mask)
+                if len(buf_idx) == K:
+                    yield (
+                        "stacked",
+                        np.concatenate(buf_idx),
+                        np.stack(buf_masks),
+                    )
+                    buf_idx, buf_masks = [], []
+            for idx, mask in zip(buf_idx, buf_masks):
+                yield "single", idx, mask
+
+        in_flight = deque()
+
+        def emit():
+            kind, mask = in_flight.popleft()
+            img, lbl, slot = pf.acquire()  # FIFO: matches oldest submission
+            if host_copy:
+                img, lbl = np.copy(img), np.copy(lbl)
+            if kind == "stacked":
+                img = img.reshape((K, -1) + img_tail)
+                lbl = lbl.reshape((K, -1) + lbl_tail)
+                sharding = self.stacked_sharding
+            else:
+                sharding = self.batch_sharding
+            dev = jax.device_put(
+                {"image": img, "label": lbl, "mask": mask}, sharding
+            )
+            # Fence ONLY the H2D transfer, then recycle the slot; the copy
+            # of batch N+depth overlaps the device computing batch N.
+            jax.block_until_ready(dev)
+            pf.release(slot)
+            return kind, dev, int(mask.sum())
+
+        for kind, idx, mask in submissions():
+            pf.submit(idx)
+            in_flight.append((kind, mask))
+            if len(in_flight) > depth:
+                yield emit()
+        while in_flight:
+            yield emit()
+
+    def close(self) -> None:
+        """Release the host prefetcher (worker thread + slot buffers)."""
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
 
     def run(self) -> dict:
+        try:
+            return self._run_impl()
+        finally:
+            self.close()
+
+    def _run_impl(self) -> dict:
         c = self.config
         start = time.time()
         throughput = Throughput(n_chips=self.world_size)
@@ -276,30 +400,20 @@ class Trainer:
             step_losses = []
             epoch_metrics = None
             n_steps = 0
-            pending = []
-            for batch in self.train_loader:
-                if self.multi_step is None:
+            for kind, dev_batch, n_real in self._epoch_stream():
+                if kind == "stacked":
+                    self.state, epoch_metrics = self.multi_step(
+                        self.state, dev_batch
+                    )
+                    step_losses.append(epoch_metrics["loss"])  # (K,)
+                    n_steps += self.steps_per_call
+                else:
                     self.state, epoch_metrics = self.train_step(
-                        self.state, self._put(batch)
+                        self.state, dev_batch
                     )
                     step_losses.append(epoch_metrics["loss"])
-                else:
-                    pending.append(batch)
-                    if len(pending) == self.steps_per_call:
-                        self.state, epoch_metrics = self.multi_step(
-                            self.state, self._put_stacked(pending)
-                        )
-                        step_losses.append(epoch_metrics["loss"])  # (K,)
-                        pending = []
-                throughput.add(int(batch["mask"].sum()))
-                n_steps += 1
-            # Epoch remainder smaller than steps_per_call: plain steps (the
-            # scan's stacked shapes are static, so no partial-K call).
-            for batch in pending:
-                self.state, epoch_metrics = self.train_step(
-                    self.state, self._put(batch)
-                )
-                step_losses.append(epoch_metrics["loss"])
+                    n_steps += 1
+                throughput.add(n_real)
             mean_loss = (
                 float(
                     np.mean(
