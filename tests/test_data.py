@@ -122,6 +122,46 @@ def test_exclude_sampler_pad_mask():
     assert sorted(seen) == sorted(labels.tolist())
 
 
+def test_multihost_local_slices_reassemble_global():
+    """Multi-host mode (SURVEY.md §7.3): every host computes the same
+    sampler permutation; host h yields rows for its contiguous device
+    block. Concatenating all hosts' local batches (in host order) must
+    reproduce the single-host global batch bit-for-bit, every step."""
+    from tpu_ddp.data.loader import ShardedBatchLoader
+
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(100, 4, 4, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, size=100)
+    kw = dict(world_size=8, per_shard_batch=4, shuffle=True, seed=3)
+    global_loader = ShardedBatchLoader(images, labels, **kw)
+    host_loaders = [
+        ShardedBatchLoader(
+            images, labels, process_index=h, process_count=4, **kw
+        )
+        for h in range(4)
+    ]
+    for h in host_loaders:
+        assert h.local_batch == global_loader.global_batch // 4
+    for epoch in (0, 1):
+        global_steps = list(global_loader.epoch_batches(epoch))
+        per_host = [list(h.epoch_batches(epoch)) for h in host_loaders]
+        for step, gbatch in enumerate(global_steps):
+            for key in ("image", "label", "mask"):
+                stitched = np.concatenate(
+                    [per_host[h][step][key] for h in range(4)]
+                )
+                np.testing.assert_array_equal(stitched, gbatch[key])
+
+
+def test_multihost_requires_divisible_world():
+    from tpu_ddp.data.loader import ShardedBatchLoader
+
+    with pytest.raises(AssertionError):
+        ShardedBatchLoader(
+            np.zeros((10, 2)), np.zeros(10), world_size=8, process_count=3
+        )
+
+
 def test_cifar10_loader_from_fake_pickles(tmp_path):
     """End-to-end pickle loading path with a synthetic on-disk dataset
     (covers _find_dataset_dir + _load_pickles for both datasets)."""

@@ -49,3 +49,91 @@ def test_fused_scan_matches_single_steps(devices):
     single = _run(prefetch_depth=0)
     fused = _run(steps_per_call=4, prefetch_depth=0)
     np.testing.assert_allclose(single, fused, rtol=1e-6)
+
+
+def test_resume_continues_identically(devices, tmp_path):
+    """Checkpoint at epoch 2 then resume for epochs 3-4 must reproduce the
+    uninterrupted 4-epoch run's loss trajectory exactly (state + data order
+    both restored) — the resume capability the reference lacks entirely
+    (SURVEY.md §5.4: save-only, no loading code)."""
+    common = dict(
+        synthetic_data=True,
+        synthetic_size=200,
+        per_shard_batch=4,
+        seed=0,
+        log_every_epochs=1,
+        checkpoint_every_epochs=2,
+    )
+    uninterrupted = TrainConfig(epochs=4, **common)
+    t_full = Trainer(uninterrupted)
+    t_full.run()
+
+    ck = str(tmp_path / "ck")
+    t_half = Trainer(TrainConfig(epochs=2, checkpoint_dir=ck, **common))
+    t_half.run()
+    t_resumed = Trainer(
+        TrainConfig(epochs=4, checkpoint_dir=ck, resume=True, **common)
+    )
+    t_resumed.run()
+    assert t_resumed.history["epoch"] == [3, 4]
+    np.testing.assert_allclose(
+        t_resumed.history["train_loss"],
+        t_full.history["train_loss"][2:],
+        rtol=1e-6,
+    )
+
+
+def test_multihost_put_path_degenerate_single_process(devices):
+    """The multi-host assembly path (make_array_from_process_local_data)
+    must agree with device_put when this process owns every device — the
+    degenerate case runnable without a pod."""
+    cfg = TrainConfig(
+        synthetic_data=True, synthetic_size=64, per_shard_batch=4, epochs=1
+    )
+    t = Trainer(cfg)
+    batch = next(iter(t.train_loader))
+    direct = t._put(batch)
+    t._multihost = True
+    assembled = t._put(batch)
+    t._multihost = False
+    for key in batch:
+        np.testing.assert_array_equal(
+            np.asarray(direct[key]), np.asarray(assembled[key])
+        )
+        assert assembled[key].sharding == direct[key].sharding
+    t.close()
+
+
+def test_cli_config_mapping(devices):
+    """argparse surface -> TrainConfig (the reference's config story is
+    hardcoded constants + a vestigial argparse, SURVEY.md §5.6)."""
+    from tpu_ddp.cli.train import build_parser, config_from_args
+
+    args = build_parser().parse_args(
+        [
+            "--device", "cpu",
+            "--synthetic-data",
+            "--epochs", "7",
+            "--global-batch-size", "64",
+            "--lr", "0.5",
+            "--momentum", "0.9",
+            "--schedule", "cosine",
+            "--model", "resnet18",
+            "--dataset", "cifar100",
+            "--steps-per-call", "8",
+            "--prefetch-depth", "0",
+            "--freeze", "head", "fc",
+            "--faithful-epoch-order",
+        ]
+    )
+    cfg = config_from_args(args)
+    assert cfg.epochs == 7
+    assert cfg.per_shard_batch == 64 // len(jax.devices())
+    assert cfg.lr == 0.5 and cfg.momentum == 0.9
+    assert cfg.schedule == "cosine"
+    assert cfg.model == "resnet18"
+    assert cfg.num_classes == 100  # inferred from --dataset cifar100
+    assert cfg.steps_per_call == 8
+    assert cfg.prefetch_depth == 0
+    assert cfg.freeze_prefixes == ("head", "fc")
+    assert cfg.reshuffle_each_epoch is False

@@ -82,13 +82,30 @@ class ShardedBatchLoader:
         seed: int = 0,
         drop_last: bool = False,
         exclude_sampler_pad: bool = False,
+        process_index: int = 0,
+        process_count: int = 1,
     ):
         """exclude_sampler_pad: also mask out the sampler-level wrap-pad
         duplicates (the samples DistributedSampler repeats to even out
         shards). Keep False for training (torch trains on the duplicates —
         faithful semantics); set True for eval/predict loaders so metrics
-        count every sample exactly once."""
+        count every sample exactly once.
+
+        process_index/process_count: multi-host mode (SURVEY.md §7.3
+        "multi-host data loading"). ``world_size`` stays the GLOBAL device
+        count and the sampler math is computed identically on every host
+        (same seed -> same permutation); each host then yields only the
+        rows for ITS contiguous block of ``world_size/process_count``
+        devices, and the trainer assembles global arrays with
+        ``jax.make_array_from_process_local_data``. The dataset arrays are
+        host-resident in full here (CIFAR-scale); for datasets too large
+        per host, pre-shard files per process and run with
+        ``shuffle`` local to each host's shard — the sampler sees the
+        host-local array and ``process_count=1`` semantics apply per host."""
         assert len(images) == len(labels)
+        assert world_size % process_count == 0, (
+            f"{world_size} devices not divisible by {process_count} hosts"
+        )
         self.images, self.labels = images, labels
         self.world_size = world_size
         self.per_shard_batch = per_shard_batch
@@ -97,6 +114,9 @@ class ShardedBatchLoader:
         self.seed = seed
         self.drop_last = drop_last
         self.exclude_sampler_pad = exclude_sampler_pad
+        self.process_index = process_index
+        self.process_count = process_count
+        self.local_world_size = world_size // process_count
         self._epoch = 0
         per_shard = math.ceil(len(images) / world_size)
         if drop_last:
@@ -107,6 +127,12 @@ class ShardedBatchLoader:
     @property
     def global_batch(self) -> int:
         return self.per_shard_batch * self.world_size
+
+    @property
+    def local_batch(self) -> int:
+        """Rows this host materializes per step (== global_batch when
+        single-host)."""
+        return self.per_shard_batch * self.local_world_size
 
     def set_epoch(self, epoch: int) -> None:
         """The fix for the reference's missing ``sampler.set_epoch`` call."""
@@ -143,12 +169,16 @@ class ShardedBatchLoader:
                 reps = -(-deficit // per_shard)  # ceil: shard may be shorter
                 pad = np.tile(shards, (1, reps))[:, :deficit]
                 chunk = np.concatenate([chunk, pad], axis=1)
-            idx = chunk.reshape(-1)  # global batch: shard-major layout
             mask = np.zeros((self.world_size, bs), bool)
             mask[:, :valid] = True
             if self.exclude_sampler_pad:
                 mask[:, :valid] &= real
-            yield idx, mask.reshape(-1)
+            # Shard-major layout: device d's rows are chunk[d]; host h owns
+            # the contiguous device block [h*lws, (h+1)*lws), so its local
+            # slice of the global batch is the matching row block.
+            lo_r = self.process_index * self.local_world_size
+            hi_r = lo_r + self.local_world_size
+            yield chunk[lo_r:hi_r].reshape(-1), mask[lo_r:hi_r].reshape(-1)
 
     def epoch_batches(self, epoch: Optional[int] = None) -> Iterator[Dict[str, np.ndarray]]:
         for idx, mask in self.epoch_index_batches(epoch):

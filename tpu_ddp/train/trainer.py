@@ -114,6 +114,12 @@ class Trainer:
         self.mesh = create_mesh(MeshSpec(data=-1), devices)
         self.world_size = len(devices)
         self.batch_sharding = batch_sharding(self.mesh)
+        # Multi-host: every process runs this same code; loaders yield only
+        # the local device block's rows and _put assembles global arrays
+        # from per-host shards (SURVEY.md §7.3 multi-host data loading).
+        self.process_count = jax.process_count()
+        self.process_index = jax.process_index()
+        self._multihost = self.process_count > 1
 
         self.model = build_model(config)
         self._load_data(train_data, test_data)
@@ -233,6 +239,8 @@ class Trainer:
             shuffle=c.shuffle,
             reshuffle_each_epoch=c.reshuffle_each_epoch,
             seed=c.seed,
+            process_index=self.process_index,
+            process_count=self.process_count,
         )
         if c.loss == "bce" and np.asarray(train[1]).ndim != 2:
             raise ValueError(
@@ -246,10 +254,24 @@ class Trainer:
             per_shard_batch=c.per_shard_batch,
             shuffle=False,
             exclude_sampler_pad=True,  # metrics count each sample once
+            process_index=self.process_index,
+            process_count=self.process_count,
         )
 
     def _put(self, batch):
-        return jax.device_put(batch, self.batch_sharding)
+        return self._put_with(batch, self.batch_sharding)
+
+    def _put_with(self, batch, sharding):
+        """Host batch -> global device array. Single-host: device_put.
+        Multi-host: each process contributes its local rows and the runtime
+        stitches the global array (no host ever materializes the full
+        batch) — the SPMD replacement for per-rank loaders."""
+        if self._multihost:
+            return {
+                k: jax.make_array_from_process_local_data(sharding, v)
+                for k, v in batch.items()
+            }
+        return jax.device_put(batch, sharding)
 
     def _epoch_stream(self):
         """Yield ``(kind, device_batch, n_real)``: kind is "stacked" for
@@ -271,7 +293,8 @@ class Trainer:
                 self._prefetcher = BatchPrefetcher(
                     self.train_loader.images,
                     self.train_loader.labels,
-                    max_batch=K * self.train_loader.global_batch,
+                    # local_batch: this host only ever gathers its own rows
+                    max_batch=K * self.train_loader.local_batch,
                     depth=depth + 1,
                 )
             yield from self._prefetched_stream(K, depth)
@@ -289,7 +312,7 @@ class Trainer:
                 }
                 yield (
                     "stacked",
-                    jax.device_put(stacked, self.stacked_sharding),
+                    self._put_with(stacked, self.stacked_sharding),
                     int(stacked["mask"].sum()),
                 )
                 pending = []
@@ -348,7 +371,7 @@ class Trainer:
                 sharding = self.stacked_sharding
             else:
                 sharding = self.batch_sharding
-            dev = jax.device_put(
+            dev = self._put_with(
                 {"image": img, "label": lbl, "mask": mask}, sharding
             )
             # Fence ONLY the H2D transfer, then recycle the slot; the copy
@@ -380,7 +403,12 @@ class Trainer:
     def _run_impl(self) -> dict:
         c = self.config
         start = time.time()
-        throughput = Throughput(n_chips=self.world_size)
+        # Multi-host: this process only counts its LOCAL rows (the loader
+        # yields the local slice), so rate against local chips; the per-chip
+        # number — the headline metric — is then correct on any pod size,
+        # and the aggregate is scaled back up below (symmetric hosts).
+        n_local_chips = self.world_size // self.process_count
+        throughput = Throughput(n_chips=n_local_chips)
         throughput.start()
         last_metrics = {}
         # Steady-state step time: measured per epoch between REAL sync points
@@ -485,7 +513,7 @@ class Trainer:
             mean_step_seconds=(
                 steady_seconds / steady_steps if steady_steps else float("nan")
             ),
-            images_per_sec=throughput.images_per_sec,
+            images_per_sec=throughput.images_per_sec * self.process_count,
             images_per_sec_per_chip=throughput.images_per_sec_per_chip,
         )
         return last_metrics
@@ -503,7 +531,12 @@ class Trainer:
     def predict(self, loader=None):
         """Batch inference over a loader: (logits, labels) as host numpy
         arrays with sampler/batch padding removed — the reference's
-        inference + prediction-dump capability (ppe_main_ddp.py:310-396)."""
+        inference + prediction-dump capability (ppe_main_ddp.py:310-396).
+
+        Multi-host: each process returns the rows of ITS device block (the
+        loader yields local batches, and only this host's output shards are
+        addressable); concatenating every host's return in process order
+        gives the full set."""
         import numpy as np
 
         from tpu_ddp.train.steps import make_predict_step
@@ -513,7 +546,16 @@ class Trainer:
         loader = loader if loader is not None else self.test_loader
         logits_all, labels_all = [], []
         for batch in loader.epoch_batches(epoch=0):
-            logits = np.asarray(self.predict_step(self.state, self._put(batch)))
+            out = self.predict_step(self.state, self._put(batch))
+            if self._multihost:
+                # global (P('data')) output: fetch this host's contiguous
+                # row block from its addressable shards, in row order
+                shards = sorted(
+                    out.addressable_shards, key=lambda s: s.index[0].start
+                )
+                logits = np.concatenate([np.asarray(s.data) for s in shards])
+            else:
+                logits = np.asarray(out)
             mask = batch["mask"]
             logits_all.append(logits[mask])
             labels_all.append(np.asarray(batch["label"])[mask])
