@@ -1,51 +1,86 @@
 #!/usr/bin/env python
-"""Benchmark: steady-state CIFAR-10 training throughput (images/sec/chip).
+"""Benchmark: steady-state CIFAR-10 training throughput + MFU.
 
-Runs the flagship DDP training path (NetResDeep, per-shard batch 32 — the
-reference recipe, ``/root/reference/main.py:27,61``) on all available devices
-and prints ONE JSON line.
+Prints ONE JSON line and always exits 0 — backend failures are *recorded*
+(an ``error`` field / CPU fallback), never a bare stack trace: round 1's
+``BENCH_r01.json`` was ``rc=1`` with no JSON because the TPU runtime was
+unavailable at collection time and ``jax.devices()`` raised at import depth.
 
-Two methodology notes:
+Architecture: the parent process NEVER initializes a JAX backend. It runs
+the measurement in a child subprocess (``--child``) with a timeout, retries
+transient TPU-backend failures, and falls back to a scrubbed
+``JAX_PLATFORMS=cpu`` child if the chip stays unavailable — so a JSON line
+is produced no matter what state the TPU runtime is in.
 
-- **Fused dispatch.** The framework's training path fuses K=32 optimizer
-  steps into one jitted ``lax.scan`` call (``make_scan_train_step``) —
-  semantically identical to K single steps
-  (test_scan_multi_step_matches_sequential) but with host/launcher overhead
-  amortized 32x. This is what ``Trainer(steps_per_call=32)`` runs.
-- **Forced completion.** Timing ends only after the final step's loss value
-  has been fetched to the host: on remote-tunneled TPU runtimes,
-  ``block_until_ready`` alone can return before the donated-buffer chain has
-  fully executed, inflating throughput >100x. Fetching a value that depends
-  on every step is the only trustworthy fence.
+Two configs are measured (VERDICT round-1 item 3):
 
-The reference publishes no numbers (BASELINE.md), so ``vs_baseline``
-compares against this framework's own measured dispatch-per-step path
-(the reference's ``main.py:32-41`` hot-loop pattern: one host dispatch per
-optimizer step), measured with the same forced-completion fence on the same
-chip. >1.0 means the fused path beats the reference-style loop.
+- **flagship** — NetResDeep, f32, per-shard batch 32: the reference recipe
+  (``/root/reference/main.py:27,61``). Dispatch-bound at this size, so the
+  framework fuses K=32 optimizer steps into one ``lax.scan`` dispatch
+  (semantically identical: test_scan_multi_step_matches_sequential).
+  ``vs_baseline`` compares against this framework's own measured
+  dispatch-per-step path (the reference's ``main.py:32-41`` per-batch
+  hot-loop pattern) on TPU v5e: 16,892 img/s/chip.
+- **compute-bound** — ResNet-50, bf16, per-shard batch 256: an
+  MXU-saturating config where MFU is meaningful.
+
+MFU = XLA cost-model FLOPs of the compiled step (fusion/scan-aware) /
+wall-clock / bf16 peak of the device kind (``tpu_ddp/metrics/mfu.py``).
+
+Timing methodology (both configs): end only after a value depending on
+every step has been fetched to the host — on remote-tunneled TPU runtimes
+``block_until_ready`` alone can return before the donated-buffer chain has
+fully executed, inflating throughput >100x.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import numpy as np
 
 # Dispatch-per-step path (reference pattern) on TPU v5e single chip,
 # per-shard batch 32, forced-completion timing: 16,892 images/sec/chip.
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 16892.0
 
+_CHILD_TIMEOUT_S = 1500
 
-def main() -> None:
+
+def _measure(step, state, batch, *, target_seconds=8.0, max_calls=50):
+    """(new_state, calls, elapsed): warm up (compile), then time `calls`
+    executions with a forced-completion fence on the final loss."""
+    import numpy as np
+
+    for _ in range(2):
+        state, metrics = step(state, batch)
+    # Fence the warmup BEFORE calibrating: with async dispatch the two
+    # warmup executions would otherwise still be in flight and inflate the
+    # single-call measurement ~3x (undersizing the timed window).
+    float(np.asarray(metrics["loss"]).reshape(-1)[-1])
+    per_call_t0 = time.perf_counter()
+    state, metrics = step(state, batch)
+    float(np.asarray(metrics["loss"]).reshape(-1)[-1])
+    per_call = max(time.perf_counter() - per_call_t0, 1e-6)
+    calls = int(max(3, min(max_calls, target_seconds / per_call)))
+
+    start = time.perf_counter()
+    for _ in range(calls):
+        state, metrics = step(state, batch)
+    float(np.asarray(metrics["loss"]).reshape(-1)[-1])
+    elapsed = time.perf_counter() - start
+    return state, calls, elapsed
+
+
+def _bench_flagship(quick: bool) -> dict:
+    import jax
+    import numpy as np
+
     from tpu_ddp.data import synthetic_cifar10
+    from tpu_ddp.metrics.mfu import compiled_flops, mfu
     from tpu_ddp.models import NetResDeep
-    from tpu_ddp.parallel import (
-        MeshSpec,
-        create_mesh,
-        stacked_batch_sharding,
-    )
+    from tpu_ddp.parallel import MeshSpec, create_mesh, stacked_batch_sharding
     from tpu_ddp.train import (
         create_train_state,
         make_optimizer,
@@ -59,7 +94,7 @@ def main() -> None:
     model = NetResDeep()
     tx = make_optimizer(lr=1e-2)
     state = create_train_state(model, tx, jax.random.key(0))
-    steps_per_call = 32
+    steps_per_call = 8 if quick else 32
     step = make_scan_train_step(model, tx, mesh, steps_per_call=steps_per_call)
 
     per_shard = 32
@@ -74,29 +109,204 @@ def main() -> None:
     }
     batch = jax.device_put(batch, stacked_batch_sharding(mesh))
 
-    # warmup / compile (incl. the loss-fetch path)
-    for _ in range(3):
-        state, metrics = step(state, batch)
-    np.asarray(metrics["loss"])
+    flops_per_call = compiled_flops(step, state, batch)
+    _, calls, elapsed = _measure(
+        step, state, batch, max_calls=5 if quick else 50
+    )
+    per_chip = calls * steps_per_call * global_batch / elapsed / n_chips
+    return {
+        "images_per_sec_per_chip": round(per_chip, 1),
+        "mfu": mfu(flops_per_call, calls / elapsed),
+        "model": "netresdeep",
+        "dtype": "float32",
+        "per_shard_batch": per_shard,
+        "steps_per_call": steps_per_call,
+        "n_chips": n_chips,
+    }
 
-    n_calls = 50
-    start = time.perf_counter()
-    for _ in range(n_calls):
-        state, metrics = step(state, batch)
-    # Forced completion: this value depends on every one of the
-    # n_calls * steps_per_call optimizer steps above.
-    float(np.asarray(metrics["loss"])[-1])
-    elapsed = time.perf_counter() - start
 
-    images_per_sec = n_calls * steps_per_call * global_batch / elapsed
-    per_chip = images_per_sec / n_chips
+def _bench_compute_bound(quick: bool) -> dict:
+    import jax
+    import numpy as np
+
+    from tpu_ddp.data import synthetic_cifar10
+    from tpu_ddp.metrics.mfu import compiled_flops, mfu
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+    from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
+    from tpu_ddp.train import create_train_state, make_optimizer, make_train_step
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = create_mesh(MeshSpec(data=-1), devices)
+
+    model = MODEL_REGISTRY["resnet50"](num_classes=10, dtype=jax.numpy.bfloat16)
+    tx = make_optimizer(lr=1e-1, momentum=0.9)
+    state = create_train_state(model, tx, jax.random.key(0))
+    step = make_train_step(model, tx, mesh)
+
+    per_shard = 64 if quick else 256
+    global_batch = per_shard * n_chips
+    imgs, labels = synthetic_cifar10(global_batch, seed=1)
+    batch = {
+        "image": imgs.astype(np.float32),
+        "label": labels,
+        "mask": np.ones(global_batch, bool),
+    }
+    batch = jax.device_put(batch, batch_sharding(mesh))
+
+    flops_per_call = compiled_flops(step, state, batch)
+    _, calls, elapsed = _measure(
+        step, state, batch, max_calls=3 if quick else 50
+    )
+    per_chip = calls * global_batch / elapsed / n_chips
+    return {
+        "images_per_sec_per_chip": round(per_chip, 1),
+        "mfu": mfu(flops_per_call, calls / elapsed),
+        "model": "resnet50",
+        "dtype": "bfloat16",
+        "per_shard_batch": per_shard,
+        "n_chips": n_chips,
+    }
+
+
+def child_main(quick: bool) -> None:
+    """Each bench config is isolated: a compute-bound failure (e.g. OOM at
+    batch 256) must not discard a successful flagship measurement — the
+    headline metric survives with the sub-bench's error recorded."""
+    import traceback
+
+    import jax
+
+    backend = jax.default_backend()
+    kind = jax.devices()[0].device_kind
+    try:
+        flagship = _bench_flagship(quick)
+    except Exception:
+        flagship = {"error": traceback.format_exc(limit=2).strip()}
+    try:
+        compute = _bench_compute_bound(quick)
+    except Exception:
+        compute = {"error": traceback.format_exc(limit=2).strip()}
+    per_chip = flagship.get("images_per_sec_per_chip")
+    mfu_val = flagship.get("mfu")
+    out = {
+        "metric": "cifar10_train_images_per_sec_per_chip",
+        "value": per_chip if per_chip is not None else 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": round(
+            (per_chip or 0.0) / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3
+        ),
+        "mfu": None if mfu_val is None else round(mfu_val, 4),
+        "backend": backend,
+        "device_kind": kind,
+        "compute_bound": {
+            **compute,
+            "mfu": (
+                None
+                if compute.get("mfu") is None
+                else round(compute["mfu"], 4)
+            ),
+        },
+    }
+    if "error" in flagship:
+        out["error"] = flagship["error"]
+    print(json.dumps(out))
+
+
+def _cpu_env(n_virtual: int = 1) -> dict:
+    from tpu_ddp.parallel.runtime import scrubbed_cpu_env
+
+    return scrubbed_cpu_env(n_virtual)
+
+
+def _probe_backend(env, timeout_s: int = 240):
+    """Cheap availability check: can a child process see devices at all?
+    Keeps the expensive bench child from burning its whole timeout against
+    a hung TPU runtime (round 1's failure mode)."""
+    code = (
+        "import jax, json; "
+        "print(json.dumps({'backend': jax.default_backend(), "
+        "'n': len(jax.devices())}))"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env, capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend probe timed out after {timeout_s}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        return False, "probe failed: " + " | ".join(tail)
+    return True, None
+
+
+def _run_child(env, quick: bool):
+    """(json_dict | None, error_string | None)"""
+    cmd = [sys.executable, os.path.abspath(__file__), "--child"]
+    if quick:
+        cmd.append("--quick")
+    try:
+        proc = subprocess.run(
+            cmd,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=_CHILD_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"child timed out after {_CHILD_TIMEOUT_S}s"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+    return None, f"rc={proc.returncode}: " + " | ".join(tail)
+
+
+def main() -> None:
+    if "--child" in sys.argv:
+        child_main(quick="--quick" in sys.argv)
+        return
+
+    errors = []
+    # Real backend, with one retry for transient runtime unavailability.
+    # A short probe precedes each attempt so a hung TPU runtime costs
+    # minutes, not the bench child's full timeout.
+    for attempt in range(2):
+        ok, err = _probe_backend(dict(os.environ))
+        if not ok:
+            errors.append(f"attempt {attempt + 1}: {err}")
+            time.sleep(15)
+            continue
+        result, err = _run_child(dict(os.environ), quick=False)
+        if result is not None and result.get("value", 0) > 0:
+            print(json.dumps(result))
+            return
+        if result is not None:  # child ran but every bench inside failed
+            err = result.get("error", "all bench configs failed")
+        errors.append(f"attempt {attempt + 1}: {err}")
+        time.sleep(15)
+    # TPU runtime stayed unavailable: record a CPU-fallback measurement so
+    # the round still has a parsed perf artifact, with the failure explicit.
+    result, err = _run_child(_cpu_env(), quick=True)
+    if result is not None:
+        result["backend_error"] = "; ".join(errors)
+        print(json.dumps(result))
+        return
+    errors.append(f"cpu fallback: {err}")
     print(
         json.dumps(
             {
                 "metric": "cifar10_train_images_per_sec_per_chip",
-                "value": round(per_chip, 1),
+                "value": 0.0,
                 "unit": "images/sec/chip",
-                "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3),
+                "vs_baseline": 0.0,
+                "error": "; ".join(errors),
             }
         )
     )

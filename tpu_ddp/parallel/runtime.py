@@ -55,6 +55,35 @@ def initialize_distributed(
     )
 
 
+def scrubbed_cpu_env(n_virtual_devices: int = 1) -> dict:
+    """Copy of os.environ forcing a subprocess onto the virtual-CPU platform:
+    drops the TPU-plugin discovery var (whose mere presence makes jax's
+    sitecustomize import hang against an unavailable/hung TPU runtime —
+    round 1's MULTICHIP rc=124), pins ``JAX_PLATFORMS=cpu``, and replaces any
+    existing ``--xla_force_host_platform_device_count`` (XLA honors the LAST
+    duplicate, so stale values must be stripped, not just appended after).
+
+    The single source of truth for this scrub — ``bench.py``'s CPU fallback
+    and ``__graft_entry__``'s dryrun re-exec both use it; keep future plugin
+    env vars to scrub HERE."""
+    import os
+    import re
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    stripped = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        env.get("XLA_FLAGS", ""),
+    )
+    env["XLA_FLAGS"] = (
+        stripped
+        + f" --xla_force_host_platform_device_count={n_virtual_devices}"
+    ).strip()
+    return env
+
+
 def is_primary_process() -> bool:
     """Single-writer predicate (process 0). Fixes the reference's
     all-ranks-write-one-checkpoint race (``main.py:45``) and interleaved

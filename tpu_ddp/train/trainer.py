@@ -416,6 +416,9 @@ class Trainer:
         # A per-step host-side timer would only measure async dispatch.
         steady_seconds = 0.0
         steady_steps = 0
+        # (kind, device_batch) retained for the post-run MFU cost analysis;
+        # holds one batch of HBM, never donated (only state is).
+        mfu_probe = None
         start_epoch = int(self.state.step) // self.train_loader.steps_per_epoch
         for epoch in range(start_epoch + 1, c.epochs + 1):
             self.train_loader.set_epoch(epoch)
@@ -441,6 +444,8 @@ class Trainer:
                     )
                     step_losses.append(epoch_metrics["loss"])
                     n_steps += 1
+                if mfu_probe is None:
+                    mfu_probe = (kind, dev_batch)
                 throughput.add(n_real)
             mean_loss = (
                 float(
@@ -515,17 +520,52 @@ class Trainer:
             ),
             images_per_sec=throughput.images_per_sec * self.process_count,
             images_per_sec_per_chip=throughput.images_per_sec_per_chip,
+            mfu=self._compute_mfu(mfu_probe, steady_steps, steady_seconds),
         )
         return last_metrics
 
+    def _compute_mfu(self, mfu_probe, steady_steps, steady_seconds):
+        """Model FLOPs Utilization of the steady-state epochs, or None.
+
+        Gated on a known TPU peak BEFORE the cost analysis: the analysis
+        costs one extra AOT compile, pointless on backends (CPU tests)
+        where no peak figure exists anyway. cost_analysis flops are PER
+        DEVICE (see metrics/mfu.py), so dividing by the per-chip peak gives
+        per-chip MFU directly — every chip runs the same partitioned
+        program concurrently."""
+        from tpu_ddp.metrics.mfu import compiled_flops, peak_flops_per_chip
+
+        if (
+            mfu_probe is None
+            or not steady_steps
+            or steady_seconds <= 0
+            or peak_flops_per_chip() is None
+        ):
+            return None
+        kind, dev_batch = mfu_probe
+        step_fn = self.multi_step if kind == "stacked" else self.train_step
+        steps_per_exec = self.steps_per_call if kind == "stacked" else 1
+        flops = compiled_flops(step_fn, self.state, dev_batch)
+        if flops is None:
+            return None
+        achieved = (flops / steps_per_exec) * (steady_steps / steady_seconds)
+        return achieved / peak_flops_per_chip()
+
     def evaluate(self) -> tuple:
-        """Test-set accuracy/loss — the eval loop the reference never had."""
-        correct = count = loss_sum = 0.0
-        for batch in self.test_loader.epoch_batches(epoch=0):
-            out = self.eval_step(self.state, self._put(batch))
-            correct += float(out["correct"])
-            count += float(out["count"])
-            loss_sum += float(out["loss_sum"])
+        """Test-set accuracy/loss — the eval loop the reference never had.
+
+        Per-batch outputs stay ON DEVICE until the end: a ``float()`` per
+        batch would force a host sync every dispatch and serialize the eval
+        pipeline, exactly the stall the train loop avoids with its single
+        epoch-end device_get."""
+        outs = [
+            self.eval_step(self.state, self._put(batch))
+            for batch in self.test_loader.epoch_batches(epoch=0)
+        ]
+        outs = jax.device_get(outs)  # ONE sync for the whole eval pass
+        correct = sum(float(o["correct"]) for o in outs)
+        count = sum(float(o["count"]) for o in outs)
+        loss_sum = sum(float(o["loss_sum"]) for o in outs)
         return correct / max(count, 1.0), loss_sum / max(count, 1.0)
 
     def predict(self, loader=None):
