@@ -1,0 +1,23 @@
+# Test/verification entry points. The suite runs on 8 virtual CPU devices
+# (conftest.py pins the platform), so no TPU is needed for any target here.
+
+PYTHON ?= python
+
+.PHONY: test dryrun bench smoke
+
+test:
+	$(PYTHON) -m pytest tests/ -x -q
+
+# The driver's multi-chip validation: compiles + runs every parallelism
+# family's full train step on an 8-virtual-device CPU mesh.
+dryrun:
+	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+bench:
+	$(PYTHON) bench.py
+
+# 2-epoch end-to-end CLI run on the virtual mesh (fast sanity check).
+smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  $(PYTHON) main.py --device cpu --synthetic-data --epochs 2 \
+	  --log-every-epochs 1 --eval-each-epoch

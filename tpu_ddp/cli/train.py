@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 
 from tpu_ddp.parallel.runtime import initialize_distributed
+from tpu_ddp.train.strategy import parse_mesh_arg
 from tpu_ddp.train.trainer import TrainConfig, Trainer
 
 
@@ -38,7 +39,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--warmup-steps", type=int, default=0)
     p.add_argument("--n-devices", type=int, default=None,
                    help="1 == the main_no_ddp.py single-device baseline")
+    p.add_argument("--parallelism",
+                   choices=["dp", "fsdp", "tp", "pp", "sp", "ep"],
+                   default=None,
+                   help="scale-out strategy: dp (default), fsdp (ZeRO-3 "
+                        "sharded state), tp (Megatron tensor parallel), pp "
+                        "(GPipe pipeline), sp (sequence parallel + ring "
+                        "attention), ep (expert parallel MoE). Default: "
+                        "inferred from --mesh, else dp")
+    p.add_argument("--mesh", default=None, metavar="AXES",
+                   help="device mesh axis sizes, e.g. data=2,model=4 "
+                        "(axes: data, pipeline, expert, sequence, model; "
+                        "-1 = rest). Naming a non-data axis infers the "
+                        "matching --parallelism")
+    p.add_argument("--microbatches", type=int, default=2,
+                   help="GPipe microbatches per step (pp only)")
+    p.add_argument("--aux-weight", type=float, default=0.01,
+                   help="MoE load-balance loss weight (MoE models only)")
     p.add_argument("--model", default="netresdeep")
+    p.add_argument("--attention", choices=["full", "flash"], default="full",
+                   help="flash = the Pallas blockwise online-softmax kernel "
+                        "(forward AND backward in-kernel), ViT-family "
+                        "models; sp mode uses ring attention regardless")
     p.add_argument("--untied-blocks", action="store_true",
                    help="independent ResBlocks (the reference's list-repeat "
                         "quirk ties them; see SURVEY.md §2.2)")
@@ -63,10 +85,28 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reproduce the missing set_epoch(): same order every epoch")
     p.add_argument("--eval-each-epoch", action="store_true")
     p.add_argument("--log-every-epochs", type=int, default=10)
+    p.add_argument("--log-every-steps", type=int, default=None,
+                   help="also log an in-epoch progress line every N steps "
+                        "(the reference's per-100-iter print, "
+                        "ppe_main_ddp.py:151-152); each line costs one "
+                        "host sync")
+    p.add_argument("--cv-mode", type=int, default=None, metavar="K",
+                   help="k-fold cross-validation over the train split "
+                        "(the reference's -cv_mode, ppe_main_ddp.py:28-37,"
+                        "91-93): trains K models, reports per-fold and "
+                        "mean val accuracy; checkpointing disabled per fold")
+    p.add_argument("--viz-predictions", default=None, metavar="DIR",
+                   help="write predictions.png (pred-vs-true image grid) + "
+                        "confusion_matrix.png after the final eval — the "
+                        "classification analogue of the reference's "
+                        "prediction drawing (ppe_main_ddp.py:355-396)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every-epochs", type=int, default=10)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--jsonl", default=None, help="metrics JSONL path")
+    p.add_argument("--profile-dir", default=None,
+                   help="emit an XLA/TPU profiler trace (TensorBoard/"
+                        "Perfetto) for one steady-state epoch")
     p.add_argument("--freeze", nargs="*", default=None, metavar="PREFIX",
                    help="train ONLY params whose top module starts with one "
                         "of these prefixes (working version of "
@@ -100,12 +140,32 @@ def config_from_args(args) -> TrainConfig:
         jax.config.update("jax_platforms", "cpu")
     n_devices = args.n_devices
     per_shard = args.batch_size
+    mesh_sizes = None if args.mesh is None else parse_mesh_arg(args.mesh)
     if args.global_batch_size:
-        world = n_devices or len(jax.devices())
-        assert args.global_batch_size % world == 0, (
-            f"global batch {args.global_batch_size} not divisible by {world} devices"
+        # The batch shards over the DATA axis only: the divisor is the
+        # data-axis size of the mesh the Trainer will actually build —
+        # including the default mesh a bare --parallelism implies (e.g.
+        # tp's {data: -1, model: 2} halves the data axis on 8 devices).
+        import math
+
+        from tpu_ddp.train.strategy import (
+            default_mesh_sizes,
+            infer_parallelism,
         )
-        per_shard = args.global_batch_size // world
+
+        total = n_devices or len(jax.devices())
+        sizes = mesh_sizes or default_mesh_sizes(
+            infer_parallelism(mesh_sizes, args.parallelism)
+        )
+        data = sizes.get("data", -1)
+        if data == -1:
+            fixed = math.prod(v for v in sizes.values() if v != -1)
+            data = total // fixed
+        assert args.global_batch_size % data == 0, (
+            f"global batch {args.global_batch_size} not divisible by "
+            f"{data} data shards"
+        )
+        per_shard = args.global_batch_size // data
     return TrainConfig(
         data_dir=args.data_dir,
         dataset=args.dataset,
@@ -118,6 +178,10 @@ def config_from_args(args) -> TrainConfig:
         schedule=None if args.schedule == "constant" else args.schedule,
         warmup_steps=args.warmup_steps,
         n_devices=n_devices,
+        parallelism=args.parallelism,
+        mesh=mesh_sizes,
+        n_microbatches=args.microbatches,
+        aux_weight=args.aux_weight,
         seed=args.seed,
         shuffle=not args.no_shuffle,
         reshuffle_each_epoch=not args.faithful_epoch_order,
@@ -127,17 +191,20 @@ def config_from_args(args) -> TrainConfig:
         remat=args.remat,
         model=args.model,
         tied_blocks=not args.untied_blocks,
+        attention=args.attention,
         num_classes=(
             args.num_classes
             if args.num_classes is not None
             else {"cifar10": 10, "cifar100": 100}[args.dataset]
         ),
         log_every_epochs=args.log_every_epochs,
+        log_every_steps=args.log_every_steps,
         eval_each_epoch=args.eval_each_epoch,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every_epochs=args.checkpoint_every_epochs,
         resume=args.resume,
         jsonl_path=args.jsonl,
+        profile_dir=args.profile_dir,
         freeze_prefixes=tuple(args.freeze) if args.freeze else None,
         loss=args.loss,
         pretrained_dir=args.pretrained_dir,
@@ -149,6 +216,44 @@ def config_from_args(args) -> TrainConfig:
     )
 
 
+def run_cv(args, config) -> dict:
+    """k-fold cross-validation mode (the reference's ``-cv_mode`` dispatch,
+    ``ppe_main_ddp.py:91-93`` -> ``k_fold_cv`` at ``:234-307``) — but
+    data-parallel over the mesh per fold instead of single-device."""
+    import dataclasses
+
+    import numpy as np
+
+    from tpu_ddp.train.kfold import run_kfold
+    from tpu_ddp.train.trainer import load_dataset
+
+    (images, labels), _ = load_dataset(config)
+    # per-fold runs are ephemeral: no checkpoint dir collisions, no resume
+    fold_config = dataclasses.replace(
+        config, checkpoint_dir=None, resume=False
+    )
+
+    def make_trainer(train_data, val_data, fold):
+        print(f"[cv] fold {fold + 1}/{args.cv_mode}")
+        return Trainer(fold_config, train_data=train_data, test_data=val_data)
+
+    results = run_kfold(
+        np.asarray(images), np.asarray(labels),
+        k=args.cv_mode, make_trainer=make_trainer, seed=config.seed,
+    )
+    accs = [r["val_accuracy"] for r in results]
+    print(
+        f"[cv] val accuracy per fold: "
+        + ", ".join(f"{a:.4f}" for a in accs)
+        + f" | mean {np.mean(accs):.4f} +- {np.std(accs):.4f}"
+    )
+    return {
+        "cv_results": results,
+        "mean_val_accuracy": float(np.mean(accs)),
+        "std_val_accuracy": float(np.std(accs)),
+    }
+
+
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
     # Device/platform selection MUST precede any backend-touching call
@@ -156,6 +261,8 @@ def main(argv=None) -> dict:
     # initialize the TPU client.
     config = config_from_args(args)
     initialize_distributed()
+    if args.cv_mode:
+        return run_cv(args, config)
     trainer = Trainer(config)
     metrics = trainer.run()
     # Final test-set eval — the measurement the reference never takes
@@ -168,9 +275,7 @@ def main(argv=None) -> dict:
         metrics["test_accuracy"] = acc
     else:  # accuracy is undefined for multi-hot targets; mAP covers it
         trainer.logger.log_text(f"final test loss: {loss:.4f}")
-    if args.dump_predictions:
-        import json
-
+    if args.dump_predictions or args.viz_predictions:
         import numpy as np
 
         logits, labels = trainer.predict()
@@ -184,14 +289,59 @@ def main(argv=None) -> dict:
             ap = mean_average_precision(scores, labels)
             trainer.logger.log_text(f"test mAP: {ap['mAP']:.4f}")
             metrics["test_mAP"] = ap["mAP"]
-            preds = multilabel_predictions(scores).tolist()
+            preds = multilabel_predictions(scores)
         else:
-            preds = np.argmax(logits, axis=-1).tolist()
-        with open(args.dump_predictions, "w") as f:
-            json.dump(
-                {"predictions": preds, "labels": np.asarray(labels).tolist()}, f
-            )
-        trainer.logger.log_text(f"predictions -> {args.dump_predictions}")
+            preds = np.argmax(logits, axis=-1)
+        if args.dump_predictions:
+            import json
+
+            with open(args.dump_predictions, "w") as f:
+                json.dump(
+                    {
+                        "predictions": np.asarray(preds).tolist(),
+                        "labels": np.asarray(labels).tolist(),
+                    },
+                    f,
+                )
+            trainer.logger.log_text(f"predictions -> {args.dump_predictions}")
+        if args.viz_predictions:
+            from tpu_ddp.parallel.runtime import is_primary_process
+
+            if args.loss != "ce":
+                trainer.logger.log_text(
+                    "--viz-predictions skipped: class-grid/confusion images "
+                    "need class-index labels (--loss ce); use the mAP/PR "
+                    "plots for multi-label"
+                )
+            elif is_primary_process():
+                from tpu_ddp.metrics.visualization import (
+                    save_prediction_artifacts,
+                )
+
+                # predict() yields rows in SAMPLER order (shard-major
+                # interleave, rank r takes rows r::ws), NOT dataset order —
+                # recover each prediction's dataset row from the loader's
+                # own index stream (same local slice predict consumed) so
+                # image i really is the sample behind pred i.
+                row_order = np.concatenate([
+                    idx[mask]
+                    for idx, mask in
+                    trainer.test_loader.epoch_index_batches(epoch=0)
+                ])
+                assert len(row_order) == len(preds), (
+                    len(row_order), len(preds)
+                )
+                paths = save_prediction_artifacts(
+                    trainer.test_loader.images[row_order],
+                    np.asarray(labels),
+                    np.asarray(preds),
+                    args.viz_predictions,
+                    num_classes=config.num_classes,
+                )
+                trainer.logger.log_text(
+                    f"prediction viz -> {paths['grid']}, "
+                    f"{paths['confusion_matrix']}"
+                )
     return metrics
 
 

@@ -151,6 +151,15 @@ class _ThreadRing:
 
     def close(self) -> None:
         self._jobs.put(None)
+        # The worker may be blocked in _out.put (consumer abandoned with a
+        # full queue) and would never reach the sentinel: drain until it
+        # exits, then join — no lingering thread on error paths.
+        while self._worker.is_alive():
+            try:
+                self._out.get_nowait()
+            except queue.Empty:
+                pass
+            self._worker.join(timeout=0.05)
 
 
 class BatchPrefetcher:

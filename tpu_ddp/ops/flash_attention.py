@@ -11,10 +11,14 @@ into the grid. Head dim is zero-padded to the 128 lane width (padding k
 contributes 0 to scores; padding v yields padded output columns that are
 sliced away).
 
-Differentiation: forward is the Pallas kernel; backward recomputes with the
-jnp reference (exact same values up to reassociation) via ``jax.custom_vjp``
-— standard practice for inference-heavy paths; a Pallas backward kernel is
-a later optimization.
+Differentiation: forward AND backward are Pallas kernels (``jax.custom_vjp``).
+The forward additionally emits the per-row logsumexp (broadcast along a
+128-lane minor dim — the TPU-friendly layout for per-row stats); the backward
+is the standard two-kernel split: a dQ kernel iterating kv-blocks innermost
+(dq accumulates in VMEM scratch) and a dK/dV kernel iterating q-blocks
+innermost — both recompute p = exp(s - lse) tile-by-tile instead of
+materializing the (T, T) probability matrix. Degenerate tilings (tiny or
+prime T) fall back to the fused jnp reference in both directions.
 """
 
 from __future__ import annotations
@@ -40,13 +44,14 @@ def _reference(q, k, v):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             scale: float, n_k: int):
     """One (q-block, kv-block) tile. The kv-block index is the innermost
     grid dim, so for a fixed q block the kernel runs n_k times back-to-back
     with VMEM scratch (acc/m/l) carrying the online-softmax state — only one
     (bq, d) + (bk, d) tile pair is resident per step; K/V stream from HBM
-    block-by-block via the BlockSpec pipeline."""
+    block-by-block via the BlockSpec pipeline. The final tile also writes
+    the row logsumexp (lane-broadcast) — the backward's residual."""
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -72,18 +77,17 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
     @pl.when(ki == n_k - 1)
     def _finalize():
         o_ref[0] = (acc_ref[:] / l_ref[:, 0:1]).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            m_ref[:, 0:1] + jnp.log(l_ref[:, 0:1]), lse_ref.shape[1:]
+        )
 
 
-def _flash_forward(q, k, v, *, block_q: int, block_k: int, interpret: bool):
-    B, T, H, D = q.shape
-    scale = 1.0 / np.sqrt(D)
+def _plan(q_shape, block_q: int, block_k: int):
+    """(bq, bk, d_pad) tiling for a (B, T, H, D) problem, or None when no
+    usable tiling exists (tiny/prime T -> jnp fallback). Deterministic, so
+    the fwd and bwd passes always agree on the path taken."""
+    _, T, _, D = q_shape
     d_pad = max(LANE, ((D + LANE - 1) // LANE) * LANE)
-
-    def fold(x):  # (B,T,H,D) -> (B*H, T, Dpad)
-        x = x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-        if d_pad != D:
-            x = jnp.pad(x, ((0, 0), (0, 0), (0, d_pad - D)))
-        return x
 
     # Largest divisor of T not exceeding the requested block: sequence
     # lengths that aren't powers of two (e.g. ViT-B/16's 196 tokens) get a
@@ -99,13 +103,57 @@ def _flash_forward(q, k, v, *, block_q: int, block_k: int, interpret: bool):
     if min(bq, bk) < _MIN_BLOCK:
         # No usable tiling (e.g. prime T): a (1, d) grid would be
         # pathological. The fused jnp path is the right tool there.
-        return _reference(q, k, v)
-    qf, kf, vf = fold(q), fold(k), fold(v)
+        return None
+    return bq, bk, d_pad
+
+
+def _fold(x, d_pad):  # (B,T,H,D) -> (B*H, T, Dpad)
+    B, T, H, D = x.shape
+    x = x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    if d_pad != D:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, d_pad - D)))
+    return x
+
+
+def _unfold(x, shape):  # (B*H, T, Dpad) -> (B,T,H,D)
+    B, T, H, D = shape
+    return x[:, :, :D].reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying `like`'s varying-mesh-axes marking: inside
+    a shard_map (the DP/SP train steps) pallas_call outputs must declare
+    their vma or tracing fails with check_vma=True."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _flash_forward(q, k, v, *, block_q: int, block_k: int, interpret: bool):
+    """Returns (out, lse) — lse is None on the jnp-fallback path."""
+    B, T, H, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+    plan = _plan(q.shape, block_q, block_k)
+    # Interpret-mode pallas under shard_map: the HLO interpreter's internal
+    # dynamic_slices mix varying/unvarying operands and fail the vma check
+    # (jax hlo_interpreter.py limitation, not a kernel bug). CPU tests of
+    # models-under-shard_map take the fused jnp path; the kernel itself is
+    # covered by the standalone tests and the real-TPU (mosaic) lowering.
+    if interpret and bool(getattr(jax.typeof(q), "vma", None)):
+        plan = None
+    if plan is None:
+        return _reference(q, k, v), None
+    bq, bk, d_pad = plan
+    qf, kf, vf = _fold(q, d_pad), _fold(k, d_pad), _fold(v, d_pad)
     n_k = T // bk
     grid = (B * H, T // bq, n_k)  # kv-block innermost: sequential carry
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_kernel, scale=scale, n_k=n_k),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, d_pad), q.dtype),
+        out_shape=[
+            _sds((B * H, T, d_pad), q.dtype, qf),
+            _sds((B * H, T, LANE), jnp.float32, qf),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d_pad), lambda i, j, kk: (i, j, 0),
@@ -115,8 +163,12 @@ def _flash_forward(q, k, v, *, block_q: int, block_k: int, interpret: bool):
             pl.BlockSpec((1, bk, d_pad), lambda i, j, kk: (i, kk, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, bq, d_pad), lambda i, j, kk: (i, j, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=[
+            pl.BlockSpec((1, bq, d_pad), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, LANE), lambda i, j, kk: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, d_pad), jnp.float32),  # acc
             pltpu.VMEM((bq, LANE), jnp.float32),   # running max
@@ -124,8 +176,123 @@ def _flash_forward(q, k, v, *, block_q: int, block_k: int, interpret: bool):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    out = out[:, :, :D].reshape(B, H, T, D).transpose(0, 2, 1, 3)
-    return out
+    return _unfold(out, q.shape), lse
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
+               dq_acc, *, scale: float, n_k: int):
+    """dQ: for a fixed q block, stream kv blocks (innermost grid dim) and
+    accumulate ds @ k in VMEM scratch; p is recomputed from the saved row
+    logsumexp, never materialized beyond one (bq, bk) tile."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros(dq_acc.shape, jnp.float32)
+
+    q = q_ref[0]
+    kb = k_ref[0]
+    s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+    p = jnp.exp(s - lse_ref[0][:, 0:1])                  # (bq, bk)
+    dp = jnp.dot(do_ref[0], v_ref[0].T,
+                 preferred_element_type=jnp.float32)      # (bq, bk)
+    ds = p * (dp - di_ref[0][:, 0:1]) * scale
+    dq_acc[:] += jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float, n_q: int):
+    """dK/dV: for a fixed kv block, stream q blocks (innermost grid dim),
+    accumulating p^T @ do and ds^T @ q in VMEM scratch."""
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros(dk_acc.shape, jnp.float32)
+        dv_acc[:] = jnp.zeros(dv_acc.shape, jnp.float32)
+
+    q = q_ref[0]
+    kb = k_ref[0]
+    do = do_ref[0]
+    s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+    p = jnp.exp(s - lse_ref[0][:, 0:1])                  # (bq, bk)
+    dv_acc[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+    dp = jnp.dot(do, v_ref[0].T, preferred_element_type=jnp.float32)
+    ds = p * (dp - di_ref[0][:, 0:1]) * scale
+    dk_acc[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, *, block_q: int, block_k: int,
+                    interpret: bool):
+    B, T, H, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+    bq, bk, d_pad = _plan(q.shape, block_q, block_k)
+    qf, kf, vf = _fold(q, d_pad), _fold(k, d_pad), _fold(v, d_pad)
+    gf = _fold(g, d_pad)
+    # di = rowsum(dO * O): cheap elementwise+reduce, XLA fuses it; stored
+    # lane-broadcast like lse so the kernels slice column 0.
+    di = jnp.broadcast_to(
+        jnp.sum(_fold(g.astype(jnp.float32), d_pad)
+                * _fold(o.astype(jnp.float32), d_pad),
+                axis=-1, keepdims=True),
+        (B * H, T, LANE),
+    )
+    n_q, n_k = T // bq, T // bk
+
+    q_spec = pl.BlockSpec((1, bq, d_pad), lambda i, j, kk: (i, j, 0),
+                          memory_space=pltpu.VMEM)
+    row_spec = pl.BlockSpec((1, bq, LANE), lambda i, j, kk: (i, j, 0),
+                            memory_space=pltpu.VMEM)
+    kv_inner = pl.BlockSpec((1, bk, d_pad), lambda i, j, kk: (i, kk, 0),
+                            memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, n_k=n_k),
+        out_shape=_sds((B * H, T, d_pad), q.dtype, gf),
+        grid=(B * H, n_q, n_k),  # kv innermost: dq carry in scratch
+        in_specs=[q_spec, kv_inner, kv_inner, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((bq, d_pad), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, di)
+
+    q_inner = pl.BlockSpec((1, bq, d_pad), lambda i, j, qq: (i, qq, 0),
+                           memory_space=pltpu.VMEM)
+    row_inner = pl.BlockSpec((1, bq, LANE), lambda i, j, qq: (i, qq, 0),
+                             memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, bk, d_pad), lambda i, j, qq: (i, j, 0),
+                           memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, n_q=n_q),
+        out_shape=[
+            _sds((B * H, T, d_pad), k.dtype, gf),
+            _sds((B * H, T, d_pad), v.dtype, gf),
+        ],
+        grid=(B * H, n_k, n_q),  # q innermost: dk/dv carry in scratch
+        in_specs=[q_inner, kv_spec, kv_spec, q_inner, row_inner, row_inner],
+        out_specs=[kv_spec, kv_spec],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d_pad), jnp.float32),
+            pltpu.VMEM((bk, d_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, di)
+    shape = q.shape
+    return _unfold(dq, shape), _unfold(dk, shape), _unfold(dv, shape)
+
+
+def _resolve_interpret(interpret):
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -133,20 +300,30 @@ def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128,
                     interpret: bool | None = None):
     """(B, T, H, D) non-causal attention. ``interpret`` defaults to True off
     TPU (CPU tests) and False on TPU."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, block_q=block_q, block_k=block_k,
-                          interpret=interpret)
+    out, _ = _flash_forward(
+        q, k, v, block_q=block_q, block_k=block_k,
+        interpret=_resolve_interpret(interpret),
+    )
+    return out
 
 
 def _fwd(q, k, v, block_q, block_k, interpret):
-    return flash_attention(q, k, v, block_q, block_k, interpret), (q, k, v)
+    out, lse = _flash_forward(
+        q, k, v, block_q=block_q, block_k=block_k,
+        interpret=_resolve_interpret(interpret),
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(_reference, q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    if lse is None:  # forward took the jnp fallback (no usable tiling)
+        _, vjp = jax.vjp(_reference, q, k, v)
+        return vjp(g)
+    return _flash_backward(
+        q, k, v, o, lse, g, block_q=block_q, block_k=block_k,
+        interpret=_resolve_interpret(interpret),
+    )
 
 
 flash_attention.defvjp(_fwd, _bwd)

@@ -80,6 +80,37 @@ def create_mesh(
     return Mesh(dev_array, AXIS_ORDER)
 
 
+def assert_process_contiguous_data_axis(mesh: Mesh, process_count: int) -> None:
+    """Multi-host data loading assumes host h's addressable devices occupy
+    the CONTIGUOUS block [h*lws, (h+1)*lws) of the data axis — the loader
+    yields exactly those rows and ``make_array_from_process_local_data``
+    places them by sharding, so a mesh built with a non-process-contiguous
+    device order would silently train on mis-assigned rows. This holds for
+    ``jax.devices()`` ordering today; this check turns the assumption into
+    a loud error instead of silent data corruption."""
+    if process_count <= 1:
+        return
+    dev = mesh.devices  # (data, pipeline, expert, sequence, model)
+    data_size = dev.shape[0]
+    if data_size % process_count:
+        raise RuntimeError(
+            f"data axis ({data_size}) not divisible by process count "
+            f"({process_count}); multi-host loading needs equal host blocks"
+        )
+    per_host = data_size // process_count
+    for d in range(data_size):
+        expect = d // per_host
+        owners = {dd.process_index for dd in dev[d].ravel()}
+        if owners != {expect}:
+            raise RuntimeError(
+                f"mesh data-axis row {d} is owned by processes "
+                f"{sorted(owners)}, expected exactly process {expect}: "
+                "the device order is not process-contiguous, so host-local "
+                "batch rows would land on the wrong devices. Build the "
+                "mesh from jax.devices() order (create_mesh default)."
+            )
+
+
 def data_parallel_mesh(n: Optional[int] = None) -> Mesh:
     devices = jax.devices()[:n] if n else None
     return create_mesh(MeshSpec(data=-1), devices)

@@ -1,0 +1,350 @@
+"""Parallelism strategy routing: the product surface for TP/PP/SP/EP/FSDP.
+
+Round 1 built every parallelism family as library + tests
+(``tpu_ddp/parallel/``); this module makes them REACHABLE from the trainer
+and CLI — ``--mesh data=2,model=4`` (or ``--parallelism fsdp``) routes the
+``Trainer`` to the matching step builder, lays the state out on the mesh,
+and provides sharded eval/predict so training, checkpointing, resume, and
+evaluation all work in every mode. The reference has nothing comparable
+(SURVEY.md §2.3: DP only, and only via the DDP wrapper, ``main.py:63``);
+this is the TPU-native scale-out surface the build brief requires.
+
+Strategy selection:
+- ``dp`` (default) — shard_map DDP-semantics step (train/steps.py).
+- ``fsdp`` — ZeRO-3: params + opt state scattered over ``data``.
+- ``tp`` — Megatron-style tensor parallel over ``model`` (ViT family).
+- ``pp`` — compiled GPipe over ``pipeline`` (ViT family).
+- ``sp`` — sequence parallel + ring attention over ``sequence`` (ViT).
+- ``ep`` — expert parallel over ``expert`` (MoE ViT family).
+
+When ``--mesh`` names a non-data axis >1 the mode is inferred from it, so
+``--mesh data=2,model=4`` alone picks ``tp``. FSDP's mesh is 1-D data, so
+it is always explicit (``--parallelism fsdp``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_ddp.parallel.mesh import (
+    AXIS_ORDER,
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPELINE_AXIS,
+    SEQUENCE_AXIS,
+)
+from tpu_ddp.train.losses import cross_entropy_loss, masked_accuracy
+from tpu_ddp.train.state import TrainState, create_train_state
+
+PARALLELISMS = ("dp", "fsdp", "tp", "pp", "sp", "ep")
+
+# Which mesh axis (other than data) each inferred mode keys on.
+_AXIS_TO_MODE = {
+    MODEL_AXIS: "tp",
+    PIPELINE_AXIS: "pp",
+    SEQUENCE_AXIS: "sp",
+    EXPERT_AXIS: "ep",
+}
+
+
+def parse_mesh_arg(text: str) -> dict:
+    """'data=2,model=4' -> {'data': 2, 'model': 4}. Axes must come from the
+    mesh's named-axis set; -1 ("rest of the devices") allowed on one axis."""
+    sizes: dict = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"--mesh entry {part!r} is not axis=size")
+        axis, _, val = part.partition("=")
+        axis = axis.strip()
+        if axis not in AXIS_ORDER:
+            raise ValueError(
+                f"unknown mesh axis {axis!r}; choose from {AXIS_ORDER}"
+            )
+        sizes[axis] = int(val)
+    if not sizes:
+        raise ValueError(f"--mesh {text!r} names no axes")
+    return sizes
+
+
+def infer_parallelism(mesh_sizes: Optional[dict], explicit: Optional[str]) -> str:
+    """Explicit flag wins; otherwise the first non-data axis sized >1 (or -1)
+    picks its mode; a pure data mesh is dp. Two sharded non-data axes is an
+    unsupported combination (each strategy owns its own step builder)."""
+    if explicit:
+        if explicit not in PARALLELISMS:
+            raise ValueError(
+                f"unknown parallelism {explicit!r}; choose from {PARALLELISMS}"
+            )
+        return explicit
+    if not mesh_sizes:
+        return "dp"
+    active = [
+        a for a in _AXIS_TO_MODE
+        if mesh_sizes.get(a, 1) != 1
+    ]
+    if len(active) > 1:
+        raise ValueError(
+            f"mesh shards multiple non-data axes {active}; pick one "
+            "parallelism family per run (combine any of them with data "
+            "parallelism instead)"
+        )
+    return _AXIS_TO_MODE[active[0]] if active else "dp"
+
+
+def default_mesh_sizes(parallelism: str) -> dict:
+    """Mesh used when --mesh is omitted: 2-way on the mode's axis, data
+    takes the rest (fsdp/dp are 1-D data meshes)."""
+    return {
+        "dp": {"data": -1},
+        "fsdp": {"data": -1},
+        "tp": {"data": -1, "model": 2},
+        "pp": {"data": -1, "pipeline": 2},
+        "sp": {"data": -1, "sequence": 2},
+        "ep": {"data": -1, "expert": 2},
+    }[parallelism]
+
+
+@dataclasses.dataclass
+class Strategy:
+    """Everything mode-specific the Trainer consumes.
+
+    ``prepare_eval`` maps the training-layout state to the layout
+    eval/predict consume — identity everywhere except PP, whose stage-
+    stacked params must be re-assembled into the plain module layout once
+    per eval pass (NOT per batch)."""
+
+    name: str
+    mesh: Mesh
+    state: TrainState
+    train_step: Callable
+    eval_step: Callable
+    predict_step: Callable
+    batch_shardings: dict            # key -> NamedSharding (train layout)
+    state_shardings: Optional[Any]   # None == fully replicated
+    data_size: int                   # mesh.shape['data'] — loader world size
+    prepare_eval: Callable = lambda state: state
+
+
+def _batch_shardings(mesh: Mesh, image_spec: P) -> dict:
+    return {
+        "image": NamedSharding(mesh, image_spec),
+        "label": NamedSharding(mesh, P(DATA_AXIS)),
+        "mask": NamedSharding(mesh, P(DATA_AXIS)),
+    }
+
+
+def _gspmd_eval_predict(
+    model, mesh, state_shardings, batch_shardings,
+    *, loss_fn, compute_accuracy, has_batch_stats,
+):
+    """Eval + predict for GSPMD-laid-out states (fsdp/tp/ep): plain global
+    ops with in_shardings pinned to the training layout — the partitioner
+    inserts the all-gathers, exactly as in the train step."""
+    replicated = NamedSharding(mesh, P())
+
+    def eval_fn(state: TrainState, batch):
+        variables = {"params": state.params}
+        if has_batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        logits = model.apply(variables, batch["image"], train=False)
+        mask = batch.get("mask")
+        loss = loss_fn(logits, batch["label"], mask)
+        if compute_accuracy:
+            correct, count = masked_accuracy(logits, batch["label"], mask)
+        else:
+            correct = jnp.zeros(())
+            count = (
+                mask.astype(jnp.float32).sum()
+                if mask is not None
+                else jnp.asarray(float(logits.shape[0]))
+            )
+        return {"correct": correct, "count": count, "loss_sum": loss * count}
+
+    def predict_fn(state: TrainState, batch):
+        variables = {"params": state.params}
+        if has_batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        return model.apply(variables, batch["image"], train=False)
+
+    eval_step = jax.jit(
+        eval_fn,
+        in_shardings=(state_shardings, batch_shardings),
+        out_shardings=replicated,
+    )
+    predict_step = jax.jit(
+        predict_fn,
+        in_shardings=(state_shardings, batch_shardings),
+        out_shardings=NamedSharding(mesh, P(DATA_AXIS)),
+    )
+    return eval_step, predict_step
+
+
+def _require_model(model, kinds: tuple, parallelism: str):
+    from tpu_ddp.models.moe import MoEViT
+    from tpu_ddp.models.vit import ViT
+
+    by_name = {"vit": ViT, "moe": MoEViT}
+    allowed = tuple(by_name[k] for k in kinds)
+    if not isinstance(model, allowed):
+        names = " or ".join(a.__name__ for a in allowed)
+        raise ValueError(
+            f"--parallelism {parallelism} needs a {names} model (its "
+            f"partition rules key on that family's parameter paths); got "
+            f"{type(model).__name__}. Pick e.g. --model vit_s4"
+            + (" / vit_moe_s4" if "moe" in kinds else "")
+        )
+
+
+def build_strategy(
+    parallelism: str,
+    mesh: Mesh,
+    model,
+    tx,
+    rng,
+    *,
+    loss_fn: Callable = cross_entropy_loss,
+    compute_accuracy: bool = True,
+    aux_weight: float = 0.01,
+    n_microbatches: int = 2,
+    initial_state: Optional[TrainState] = None,
+) -> Strategy:
+    """Build the full strategy for any non-dp mode on a prebuilt mesh. (The
+    dp path stays in Trainer: its shard_map step, scan fusion, and
+    augmentation pipeline are the flagship and predate this router.)
+
+    ``initial_state``: an unsharded TrainState to lay out instead of a fresh
+    init (the fine-tune path). PP converts params to its stage-stacked
+    layout itself and does not accept one.
+    """
+    from tpu_ddp.parallel.partitioning import shard_train_state
+    from tpu_ddp.train.steps import make_eval_step, make_predict_step
+
+    data_size = mesh.shape[DATA_AXIS]
+    replicated = NamedSharding(mesh, P())
+
+    if parallelism == "sp":
+        _require_model(model, ("vit",), "sp")
+        from tpu_ddp.parallel.sequence_parallel import make_sp_train_step
+
+        sp_model = model.clone(sp_axis=SEQUENCE_AXIS)
+        plain = model.clone(sp_axis=None)
+        # Init through the PLAIN module: the SP module needs a live mesh
+        # axis even to trace (ring position indexing), but its param shapes
+        # are identical by construction (models/vit.py docstring).
+        state = initial_state or create_train_state(plain, tx, rng)
+        state = jax.device_put(state, replicated)
+        step = make_sp_train_step(sp_model, tx, mesh, loss_fn=loss_fn)
+        # Eval/predict also run the plain module: attention math is the
+        # same, so the standard shard_map eval replicates over the sequence
+        # axis and stays exact.
+        return Strategy(
+            name="sp", mesh=mesh, state=state, train_step=step,
+            eval_step=make_eval_step(
+                plain, mesh, loss_fn=loss_fn, compute_accuracy=compute_accuracy
+            ),
+            predict_step=make_predict_step(plain, mesh),
+            batch_shardings=_batch_shardings(
+                mesh, P(DATA_AXIS, SEQUENCE_AXIS)
+            ),
+            state_shardings=None,
+            data_size=data_size,
+        )
+
+    if parallelism == "pp":
+        _require_model(model, ("vit",), "pp")
+        from tpu_ddp.parallel.pipeline import (
+            create_pp_train_state,
+            from_pipeline_params,
+            make_pp_train_step,
+        )
+
+        if initial_state is not None:
+            raise ValueError(
+                "pretrained restore into the pipeline layout is not "
+                "supported yet; fine-tune with dp/fsdp/tp instead"
+            )
+        state = create_pp_train_state(model, tx, rng)
+        step, shardings = make_pp_train_step(
+            model, tx, mesh, state,
+            n_microbatches=n_microbatches, loss_fn=loss_fn,
+        )
+        state = shard_train_state(state, shardings)
+
+        plain_eval = make_eval_step(
+            model, mesh, loss_fn=loss_fn, compute_accuracy=compute_accuracy
+        )
+        plain_predict = make_predict_step(model, mesh)
+
+        def prepare_eval(pp_state: TrainState) -> TrainState:
+            """Stage-stacked params -> plain module layout, ONCE per eval
+            pass: gather the block stack to host (eval cadence, not step
+            cadence) and re-replicate as a plain-ViT TrainState. opt_state
+            is irrelevant to eval; reuse the pp one uninspected."""
+            plain_params = from_pipeline_params(
+                jax.device_get(pp_state.params), model.depth
+            )
+            return jax.device_put(
+                pp_state.replace(params=plain_params), replicated
+            )
+
+        return Strategy(
+            name="pp", mesh=mesh, state=state, train_step=step,
+            eval_step=plain_eval, predict_step=plain_predict,
+            batch_shardings=_batch_shardings(mesh, P(DATA_AXIS)),
+            state_shardings=shardings, data_size=data_size,
+            prepare_eval=prepare_eval,
+        )
+
+    # GSPMD family: fsdp / tp / ep share the step + eval machinery.
+    if parallelism == "fsdp":
+        from tpu_ddp.parallel.tensor_parallel import make_fsdp_train_step
+
+        state = initial_state or create_train_state(model, tx, rng)
+        has_bs = bool(jax.tree.leaves(state.batch_stats))
+        step, shardings = make_fsdp_train_step(
+            model, tx, mesh, state,
+            loss_fn=loss_fn, has_batch_stats=has_bs, aux_weight=aux_weight,
+        )
+    elif parallelism == "tp":
+        _require_model(model, ("vit", "moe"), "tp")
+        from tpu_ddp.parallel.tensor_parallel import make_tp_train_step
+
+        state = initial_state or create_train_state(model, tx, rng)
+        has_bs = False  # ViT family: no BatchNorm
+        step, shardings = make_tp_train_step(
+            model, tx, mesh, state, loss_fn=loss_fn, aux_weight=aux_weight
+        )
+    elif parallelism == "ep":
+        _require_model(model, ("moe",), "ep")
+        from tpu_ddp.parallel.expert_parallel import make_ep_train_step
+
+        state = initial_state or create_train_state(model, tx, rng)
+        has_bs = False
+        step, shardings = make_ep_train_step(
+            model, tx, mesh, state, loss_fn=loss_fn, aux_weight=aux_weight
+        )
+    else:
+        raise ValueError(f"unknown parallelism {parallelism!r}")
+
+    state = shard_train_state(state, shardings)
+    batch_shardings = _batch_shardings(mesh, P(DATA_AXIS))
+    eval_step, predict_step = _gspmd_eval_predict(
+        model, mesh, shardings, batch_shardings,
+        loss_fn=loss_fn, compute_accuracy=compute_accuracy,
+        has_batch_stats=has_bs,
+    )
+    return Strategy(
+        name=parallelism, mesh=mesh, state=state, train_step=step,
+        eval_step=eval_step, predict_step=predict_step,
+        batch_shardings=batch_shardings, state_shardings=shardings,
+        data_size=data_size,
+    )

@@ -46,6 +46,12 @@ class TrainConfig:
     schedule: Optional[str] = None        # "cosine" | None
     warmup_steps: int = 0
     n_devices: Optional[int] = None       # None = all; 1 = main_no_ddp mode
+    parallelism: Optional[str] = None     # dp|fsdp|tp|pp|sp|ep; None = infer
+                                          # from mesh (default dp)
+    mesh: Optional[dict] = None           # axis sizes, e.g. {"data": 2,
+                                          # "model": 4}; None = strategy default
+    n_microbatches: int = 2               # GPipe microbatches (pp only)
+    aux_weight: float = 0.01              # MoE load-balance loss weight
     seed: int = 0
     shuffle: bool = True
     reshuffle_each_epoch: bool = True     # False = faithful missing-set_epoch
@@ -63,13 +69,25 @@ class TrainConfig:
                                           # trade FLOPs for HBM on big models
     model: str = "netresdeep"
     tied_blocks: bool = True              # the reference's weight-tying quirk
+    attention: str = "full"               # full | flash (Pallas kernel,
+                                          # ViT-family models; fwd AND bwd
+                                          # run in-kernel)
     num_classes: int = 10
     log_every_epochs: int = 10            # main.py:43
+    log_every_steps: Optional[int] = None  # in-epoch progress lines (the
+                                          # reference's per-100-iter print,
+                                          # ppe_main_ddp.py:151-152). Each
+                                          # line fetches that step's loss —
+                                          # an occasional host sync, by
+                                          # explicit user choice
     eval_each_epoch: bool = False
     checkpoint_dir: Optional[str] = None
     checkpoint_every_epochs: int = 10     # save on log epochs, main.py:45
     resume: bool = False
     jsonl_path: Optional[str] = None
+    profile_dir: Optional[str] = None     # emit an XLA/TPU trace (Tensor-
+                                          # Board/Perfetto) for ONE steady-
+                                          # state epoch (SURVEY.md §5.1)
     freeze_prefixes: Optional[tuple] = None  # e.g. ("fc",) trains head only
     loss: str = "ce"                      # "ce" | "bce" (multi-label,
                                           # ppe_main_ddp.py:147)
@@ -96,11 +114,40 @@ def build_model(config: TrainConfig):
             dtype=dtype,
         )
     if name in MODEL_REGISTRY:
-        return MODEL_REGISTRY[name](
+        model = MODEL_REGISTRY[name](
             num_classes=config.num_classes, bn_cross_replica_axis=bn_axis,
             dtype=dtype,
         )
+        if config.attention == "flash":
+            if not hasattr(model, "attention_impl"):
+                raise ValueError(
+                    f"--attention flash needs an attention model (ViT "
+                    f"family); {config.model!r} has none"
+                )
+            from tpu_ddp.ops.flash_attention import flash_attention
+
+            model = model.clone(attention_impl=flash_attention)
+        return model
     raise ValueError(f"unknown model {config.model!r}")
+
+
+def load_dataset(c: TrainConfig):
+    """(train, test) (images, labels) tuples for a config — shared by the
+    Trainer and the k-fold CV driver (which re-splits the train set itself,
+    the reference's ``cv_mode`` path, ``ppe_main_ddp.py:91-93``)."""
+    if c.synthetic_data:
+        from tpu_ddp.data.cifar10 import synthetic_cifar10, synthetic_multilabel
+
+        gen = synthetic_multilabel if c.loss == "bce" else synthetic_cifar10
+        train = gen(c.synthetic_size, c.num_classes, c.seed)
+        test = gen(max(c.synthetic_size // 5, 64), c.num_classes, c.seed + 1)
+    else:
+        from tpu_ddp.data.cifar10 import load_cifar10, load_cifar100
+
+        load = {"cifar10": load_cifar10, "cifar100": load_cifar100}[c.dataset]
+        train = load(c.data_dir, train=True)
+        test = load(c.data_dir, train=False)
+    return train, test
 
 
 class Trainer:
@@ -111,8 +158,21 @@ class Trainer:
         devices = jax.devices()
         if config.n_devices:
             devices = devices[: config.n_devices]
-        self.mesh = create_mesh(MeshSpec(data=-1), devices)
+        from tpu_ddp.train.strategy import (
+            default_mesh_sizes,
+            infer_parallelism,
+        )
+
+        # Parallelism routing (dp is the flagship default): --mesh /
+        # --parallelism pick the strategy; the mesh is built here so the
+        # data loader can size itself off the data axis.
+        self.parallelism = infer_parallelism(config.mesh, config.parallelism)
+        sizes = dict(config.mesh or default_mesh_sizes(self.parallelism))
+        self.mesh = create_mesh(MeshSpec(**sizes), devices)
         self.world_size = len(devices)
+        # Batch rows shard over the DATA axis only — on a 2-D mesh the
+        # loader produces data_size shards, not one per device.
+        self.data_size = self.mesh.shape[DATA_AXIS]
         self.batch_sharding = batch_sharding(self.mesh)
         # Multi-host: every process runs this same code; loaders yield only
         # the local device block's rows and _put assembles global arrays
@@ -120,6 +180,12 @@ class Trainer:
         self.process_count = jax.process_count()
         self.process_index = jax.process_index()
         self._multihost = self.process_count > 1
+        if self._multihost:
+            from tpu_ddp.parallel.mesh import (
+                assert_process_contiguous_data_axis,
+            )
+
+            assert_process_contiguous_data_axis(self.mesh, self.process_count)
 
         self.model = build_model(config)
         self._load_data(train_data, test_data)
@@ -138,6 +204,55 @@ class Trainer:
             warmup_steps=config.warmup_steps,
             freeze_predicate=freeze,
         )
+        from tpu_ddp.train.losses import (
+            binary_cross_entropy_with_logits,
+            cross_entropy_loss,
+        )
+
+        if config.loss == "ce":
+            loss_fn, with_acc = cross_entropy_loss, True
+        elif config.loss == "bce":
+            loss_fn, with_acc = binary_cross_entropy_with_logits, False
+        else:
+            raise ValueError(f"unknown loss {config.loss!r}")
+        self._loss_fn, self._with_acc = loss_fn, with_acc
+
+        self.state_shardings = None   # None == fully replicated (dp/sp)
+        self._prepare_eval = None     # strategy hook (pp re-layouts params)
+        if self.parallelism == "dp":
+            self._init_dp_steps(loss_fn, with_acc)
+        else:
+            self._init_strategy_steps(loss_fn, with_acc)
+        self._prefetcher = None   # built lazily on first epoch
+        self.history: dict = {"epoch": [], "train_loss": []}
+        self.logger = MetricLogger(jsonl_path=config.jsonl_path)
+
+        self.checkpointer = None
+        if config.checkpoint_dir:
+            from tpu_ddp.checkpoint import Checkpointer
+
+            self.checkpointer = Checkpointer(config.checkpoint_dir)
+            if config.resume and self.checkpointer.latest_step() is not None:
+                from tpu_ddp.parallel.mesh import replicated_sharding
+
+                restored = self.checkpointer.restore(self.state)
+                # Lay restored arrays back out in the TRAINING layout: the
+                # sharded strategies (fsdp/tp/pp/ep) resume scattered, the
+                # replicated ones (dp/sp) resume replicated — the restore
+                # template (self.state) already carries the right shardings,
+                # this device_put just pins the invariant.
+                self.state = jax.device_put(
+                    restored,
+                    self.state_shardings or replicated_sharding(self.mesh),
+                )
+                self.logger.log_text(
+                    f"resumed from step {int(self.state.step)}"
+                )
+
+    def _init_dp_steps(self, loss_fn, with_acc):
+        """Flagship data-parallel path: shard_map DDP-semantics step, scan
+        fusion, on-device augmentation, replicated state."""
+        config = self.config
         if config.pretrained_dir:
             from tpu_ddp.parallel.mesh import replicated_sharding
             from tpu_ddp.train.finetune import load_pretrained_for_finetune
@@ -155,21 +270,11 @@ class Trainer:
             self.state = create_train_state(
                 self.model, self.tx, jax.random.key(config.seed)
             )
-        from tpu_ddp.train.losses import (
-            binary_cross_entropy_with_logits,
-            cross_entropy_loss,
-        )
-
-        if config.loss == "ce":
-            loss_fn, with_acc = cross_entropy_loss, True
-        elif config.loss == "bce":
-            loss_fn, with_acc = binary_cross_entropy_with_logits, False
-        else:
-            raise ValueError(f"unknown loss {config.loss!r}")
         self.train_step = make_train_step(
             self.model, self.tx, self.mesh,
             loss_fn=loss_fn, compute_accuracy=with_acc, remat=config.remat,
             augment=config.augment, augment_seed=config.seed,
+            aux_weight=config.aux_weight,
         )
         self.multi_step = None
         # Clamp to the epoch length: a scan longer than the epoch would
@@ -187,54 +292,81 @@ class Trainer:
                 loss_fn=loss_fn, compute_accuracy=with_acc,
                 remat=config.remat,
                 augment=config.augment, augment_seed=config.seed,
+                aux_weight=config.aux_weight,
             )
             self.stacked_sharding = stacked_batch_sharding(self.mesh)
         self.eval_step = make_eval_step(
             self.model, self.mesh, loss_fn=loss_fn, compute_accuracy=with_acc
         )
         self.predict_step = None  # built lazily in predict()
-        self._prefetcher = None   # built lazily on first epoch
-        self.history: dict = {"epoch": [], "train_loss": []}
-        self.logger = MetricLogger(jsonl_path=config.jsonl_path)
 
-        self.checkpointer = None
-        if config.checkpoint_dir:
-            from tpu_ddp.checkpoint import Checkpointer
+    def _init_strategy_steps(self, loss_fn, with_acc):
+        """Sharded-parallelism path (fsdp/tp/pp/sp/ep): route to the
+        strategy's step builders, lay the state out on the mesh, and take
+        the strategy's sharded eval/predict."""
+        config = self.config
+        from tpu_ddp.train.strategy import build_strategy
 
-            self.checkpointer = Checkpointer(config.checkpoint_dir)
-            if config.resume and self.checkpointer.latest_step() is not None:
-                from tpu_ddp.parallel.mesh import replicated_sharding
-
-                restored = self.checkpointer.restore(self.state)
-                # Restored arrays come back committed to one device; the
-                # train step needs them replicated across the mesh.
-                self.state = jax.device_put(
-                    restored, replicated_sharding(self.mesh)
+        for flag, name in (
+            (config.augment, "--augment"),
+            (config.remat, "--remat"),
+            (config.sync_bn, "--sync-bn"),
+        ):
+            if flag:
+                raise ValueError(
+                    f"{name} is only supported with data parallelism "
+                    f"(got --parallelism {self.parallelism})"
                 )
-                self.logger.log_text(
-                    f"resumed from step {int(self.state.step)}"
-                )
+        if config.steps_per_call > 1:
+            import warnings
+
+            warnings.warn(
+                f"steps_per_call={config.steps_per_call} ignored: scan "
+                "fusion is dp-only",
+                stacklevel=2,
+            )
+        initial = None
+        if config.pretrained_dir:
+            from tpu_ddp.train.finetune import load_pretrained_for_finetune
+
+            initial = load_pretrained_for_finetune(
+                config.pretrained_dir,
+                self.model,
+                self.tx,
+                rng=jax.random.key(config.seed),
+            )
+        strategy = build_strategy(
+            self.parallelism,
+            self.mesh,
+            self.model,
+            self.tx,
+            jax.random.key(config.seed),
+            loss_fn=loss_fn,
+            compute_accuracy=with_acc,
+            aux_weight=config.aux_weight,
+            n_microbatches=config.n_microbatches,
+            initial_state=initial,
+        )
+        self.state = strategy.state
+        self.train_step = strategy.train_step
+        self.eval_step = strategy.eval_step
+        self.predict_step = strategy.predict_step
+        self.batch_sharding = strategy.batch_shardings
+        self.state_shardings = strategy.state_shardings
+        self._prepare_eval = strategy.prepare_eval
+        self.multi_step = None
+        self.steps_per_call = 1
 
     def _load_data(self, train_data=None, test_data=None):
         c = self.config
         if train_data is not None:
             train = train_data
             test = test_data if test_data is not None else train_data
-        elif c.synthetic_data:
-            from tpu_ddp.data.cifar10 import synthetic_cifar10, synthetic_multilabel
-
-            gen = synthetic_multilabel if c.loss == "bce" else synthetic_cifar10
-            train = gen(c.synthetic_size, c.num_classes, c.seed)
-            test = gen(max(c.synthetic_size // 5, 64), c.num_classes, c.seed + 1)
         else:
-            from tpu_ddp.data.cifar10 import load_cifar10, load_cifar100
-
-            load = {"cifar10": load_cifar10, "cifar100": load_cifar100}[c.dataset]
-            train = load(c.data_dir, train=True)
-            test = load(c.data_dir, train=False)
+            train, test = load_dataset(c)
         self.train_loader = ShardedBatchLoader(
             *train,
-            world_size=self.world_size,
+            world_size=self.data_size,
             per_shard_batch=c.per_shard_batch,
             shuffle=c.shuffle,
             reshuffle_each_epoch=c.reshuffle_each_epoch,
@@ -250,7 +382,7 @@ class Trainer:
             )
         self.test_loader = ShardedBatchLoader(
             *test,
-            world_size=self.world_size,
+            world_size=self.data_size,
             per_shard_batch=c.per_shard_batch,
             shuffle=False,
             exclude_sampler_pad=True,  # metrics count each sample once
@@ -266,12 +398,19 @@ class Trainer:
         Multi-host: each process contributes its local rows and the runtime
         stitches the global array (no host ever materializes the full
         batch) — the SPMD replacement for per-rank loaders."""
+        pick = (
+            sharding.get if isinstance(sharding, dict)
+            else (lambda k, s=sharding: s)
+        )
         if self._multihost:
             return {
-                k: jax.make_array_from_process_local_data(sharding, v)
+                k: jax.make_array_from_process_local_data(pick(k), v)
                 for k, v in batch.items()
             }
-        return jax.device_put(batch, sharding)
+        return jax.device_put(
+            batch, {k: pick(k) for k in batch} if isinstance(sharding, dict)
+            else sharding
+        )
 
     def _epoch_stream(self):
         """Yield ``(kind, device_batch, n_real)``: kind is "stacked" for
@@ -338,7 +477,19 @@ class Trainer:
         loader = self.train_loader
         img_tail = loader.images.shape[1:]
         lbl_tail = loader.labels.shape[1:]
-        host_copy = pf.reusable_slots and jax.default_backend() == "cpu"
+        # Copy UNLESS the backend is known to complete a real H2D copy by
+        # block_until_ready (TPU/GPU — incl. experimental TPU platforms
+        # whose backend name differs but whose device kind says TPU): any
+        # backend that may zero-copy-alias host memory (CPU does, and
+        # ignores may_alias=False) would otherwise see slot reuse corrupt
+        # batches the compiled step hasn't consumed yet. Unknown backends
+        # fail SAFE (copy).
+        kind = jax.devices()[0].device_kind.lower()
+        real_h2d = (
+            jax.default_backend() in ("tpu", "gpu", "cuda", "rocm")
+            or "tpu" in kind
+        )
+        host_copy = pf.reusable_slots and not real_h2d
 
         def submissions():
             buf_idx, buf_masks = [], []
@@ -420,8 +571,15 @@ class Trainer:
         # holds one batch of HBM, never donated (only state is).
         mfu_probe = None
         start_epoch = int(self.state.step) // self.train_loader.steps_per_epoch
+        # Trace the FIRST STEADY-STATE epoch (epoch 2 of the run: epoch 1 is
+        # XLA-compile-dominated); a 1-epoch run traces what it has.
+        profile_epoch = (
+            min(start_epoch + 2, c.epochs) if c.profile_dir else None
+        )
         for epoch in range(start_epoch + 1, c.epochs + 1):
             self.train_loader.set_epoch(epoch)
+            if epoch == profile_epoch:
+                jax.profiler.start_trace(c.profile_dir)
             epoch_t0 = time.perf_counter()
             # Per-step losses stay ON DEVICE during the epoch: fetching them
             # eagerly (the reference's per-batch ``loss.item()``,
@@ -447,6 +605,19 @@ class Trainer:
                 if mfu_probe is None:
                     mfu_probe = (kind, dev_batch)
                 throughput.add(n_real)
+                if c.log_every_steps:
+                    dn = self.steps_per_call if kind == "stacked" else 1
+                    if (n_steps // c.log_every_steps) > (
+                        (n_steps - dn) // c.log_every_steps
+                    ):
+                        # reference in-epoch line (ppe_main_ddp.py:151-152);
+                        # fetching this loss is the line's one host sync
+                        cur = float(
+                            np.asarray(epoch_metrics["loss"]).reshape(-1)[-1]
+                        )
+                        self.logger.log_text(
+                            f"Epoch {epoch}, iter {n_steps}, loss {cur:.4f}"
+                        )
             mean_loss = (
                 float(
                     np.mean(
@@ -461,6 +632,10 @@ class Trainer:
             if epoch > start_epoch + 1:  # device_get above = a sync boundary
                 steady_seconds += time.perf_counter() - epoch_t0
                 steady_steps += n_steps
+            if epoch == profile_epoch:
+                # the device_get above already fenced the epoch's dispatches
+                jax.profiler.stop_trace()
+                self.logger.log_text(f"profiler trace -> {c.profile_dir}")
             self.history["epoch"].append(epoch)
             self.history["train_loss"].append(mean_loss)
             if epoch == 1 or epoch % c.log_every_epochs == 0:
@@ -558,8 +733,11 @@ class Trainer:
         batch would force a host sync every dispatch and serialize the eval
         pipeline, exactly the stall the train loop avoids with its single
         epoch-end device_get."""
+        eval_state = (
+            self._prepare_eval(self.state) if self._prepare_eval else self.state
+        )
         outs = [
-            self.eval_step(self.state, self._put(batch))
+            self.eval_step(eval_state, self._put(batch))
             for batch in self.test_loader.epoch_batches(epoch=0)
         ]
         outs = jax.device_get(outs)  # ONE sync for the whole eval pass
@@ -584,9 +762,12 @@ class Trainer:
         if self.predict_step is None:
             self.predict_step = make_predict_step(self.model, self.mesh)
         loader = loader if loader is not None else self.test_loader
+        pred_state = (
+            self._prepare_eval(self.state) if self._prepare_eval else self.state
+        )
         logits_all, labels_all = [], []
         for batch in loader.epoch_batches(epoch=0):
-            out = self.predict_step(self.state, self._put(batch))
+            out = self.predict_step(pred_state, self._put(batch))
             if self._multihost:
                 # global (P('data')) output: fetch this host's contiguous
                 # row block from its addressable shards, in row order
