@@ -169,6 +169,48 @@ def _bench_compute_bound(quick: bool) -> dict:
     }
 
 
+def _bench_attention(quick: bool) -> dict:
+    """flash (Pallas) vs full (fused jnp) attention on the same ViT train
+    step: the measured justification for --attention flash. Skipped in
+    quick/CPU-fallback mode (interpret-mode Pallas timing is meaningless)."""
+    import jax
+    import numpy as np
+
+    from tpu_ddp.data import synthetic_cifar10
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+    from tpu_ddp.ops.flash_attention import flash_attention
+    from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
+    from tpu_ddp.train import create_train_state, make_optimizer, make_train_step
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = create_mesh(MeshSpec(data=-1), devices)
+    per_shard = 128
+    global_batch = per_shard * n_chips
+    imgs, labels = synthetic_cifar10(global_batch, seed=2)
+    batch = {
+        "image": imgs.astype(np.float32),
+        "label": labels,
+        "mask": np.ones(global_batch, bool),
+    }
+    batch = jax.device_put(batch, batch_sharding(mesh))
+
+    out = {}
+    for name, impl in (("full", None), ("flash", flash_attention)):
+        model = MODEL_REGISTRY["vit_s4"](
+            num_classes=10, dtype=jax.numpy.bfloat16
+        )
+        if impl is not None:
+            model = model.clone(attention_impl=impl)
+        tx = make_optimizer(lr=1e-2, momentum=0.9)
+        state = create_train_state(model, tx, jax.random.key(0))
+        step = make_train_step(model, tx, mesh)
+        _, calls, elapsed = _measure(step, state, batch, target_seconds=5.0)
+        out[name] = round(calls * global_batch / elapsed / n_chips, 1)
+    out["flash_speedup"] = round(out["flash"] / out["full"], 3)
+    return out
+
+
 def child_main(quick: bool) -> None:
     """Each bench config is isolated: a compute-bound failure (e.g. OOM at
     batch 256) must not discard a successful flagship measurement — the
@@ -177,6 +219,15 @@ def child_main(quick: bool) -> None:
 
     import jax
 
+    # Persistent compile cache: a retried child (parent retries transient
+    # failures) skips recompiling identical programs.
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", "/tmp/tpu_ddp_xla_cache"
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
     backend = jax.default_backend()
     kind = jax.devices()[0].device_kind
     try:
@@ -187,6 +238,12 @@ def child_main(quick: bool) -> None:
         compute = _bench_compute_bound(quick)
     except Exception:
         compute = {"error": traceback.format_exc(limit=2).strip()}
+    attention = None
+    if not quick and backend != "cpu":  # interpret-mode timing: meaningless
+        try:
+            attention = _bench_attention(quick)
+        except Exception:
+            attention = {"error": traceback.format_exc(limit=2).strip()}
     per_chip = flagship.get("images_per_sec_per_chip")
     mfu_val = flagship.get("mfu")
     out = {
@@ -208,6 +265,8 @@ def child_main(quick: bool) -> None:
             ),
         },
     }
+    if attention is not None:
+        out["attention_bench"] = attention
     if "error" in flagship:
         out["error"] = flagship["error"]
     print(json.dumps(out))

@@ -104,6 +104,33 @@ def test_global_batch_divides_by_data_axis_not_device_count():
     assert config.per_shard_batch == 64
 
 
+def test_label_smoothing_loss_values():
+    import jax.numpy as jnp
+
+    from tpu_ddp.train.losses import cross_entropy_loss
+
+    logits = jnp.array([[2.0, -1.0, 0.5], [0.0, 3.0, -2.0]])
+    labels = jnp.array([0, 1])
+    base = cross_entropy_loss(logits, labels)
+    smoothed = cross_entropy_loss(logits, labels, label_smoothing=0.1)
+    # s=0 is exactly the hard-target loss
+    np.testing.assert_allclose(
+        float(cross_entropy_loss(logits, labels, label_smoothing=0.0)),
+        float(base), rtol=1e-6,
+    )
+    # manual soft-target computation
+    import jax
+
+    lp = jax.nn.log_softmax(logits)
+    n = logits.shape[-1]
+    expect = 0.0
+    for i, y in enumerate([0, 1]):
+        target = np.full(n, 0.1 / n)
+        target[y] += 0.9
+        expect += -(target * np.asarray(lp[i])).sum()
+    np.testing.assert_allclose(float(smoothed), expect / 2, rtol=1e-5)
+
+
 def test_confusion_matrix_values():
     from tpu_ddp.metrics.visualization import confusion_matrix
 

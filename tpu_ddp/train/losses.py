@@ -17,9 +17,19 @@ import jax
 import jax.numpy as jnp
 
 
-def cross_entropy_loss(logits, labels, mask: Optional[jnp.ndarray] = None):
+def cross_entropy_loss(logits, labels, mask: Optional[jnp.ndarray] = None,
+                       *, label_smoothing: float = 0.0):
     log_probs = jax.nn.log_softmax(logits)
-    nll = -jnp.take_along_axis(log_probs, labels[:, None], axis=-1)[:, 0]
+    if label_smoothing:
+        # soft target: (1-s) on the true class, s/K spread over all classes
+        n = logits.shape[-1]
+        true_lp = jnp.take_along_axis(log_probs, labels[:, None], axis=-1)[:, 0]
+        nll = -(
+            (1.0 - label_smoothing) * true_lp
+            + (label_smoothing / n) * log_probs.sum(axis=-1)
+        )
+    else:
+        nll = -jnp.take_along_axis(log_probs, labels[:, None], axis=-1)[:, 0]
     if mask is None:
         return nll.mean()
     mask = mask.astype(nll.dtype)

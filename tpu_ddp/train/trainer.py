@@ -91,6 +91,8 @@ class TrainConfig:
     freeze_prefixes: Optional[tuple] = None  # e.g. ("fc",) trains head only
     loss: str = "ce"                      # "ce" | "bce" (multi-label,
                                           # ppe_main_ddp.py:147)
+    label_smoothing: float = 0.0          # soft CE targets (recipe knob
+                                          # for the 93% north star)
     pretrained_dir: Optional[str] = None  # fine-tune: partial restore +
                                           # head swap (ppe_main_ddp.py:104-111)
     plot_curves: Optional[str] = None     # PNG path (ppe_main_ddp.py:176-181)
@@ -211,6 +213,13 @@ class Trainer:
 
         if config.loss == "ce":
             loss_fn, with_acc = cross_entropy_loss, True
+            if config.label_smoothing:
+                import functools
+
+                loss_fn = functools.partial(
+                    cross_entropy_loss,
+                    label_smoothing=config.label_smoothing,
+                )
         elif config.loss == "bce":
             loss_fn, with_acc = binary_cross_entropy_with_logits, False
         else:
