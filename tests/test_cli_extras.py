@@ -141,3 +141,18 @@ def test_confusion_matrix_values():
     assert cm[1, 1] == 1
     assert cm[2, 2] == 2 and cm[2, 0] == 1
     assert cm.sum() == len(labels)
+
+
+def test_device_tpu_fails_loudly_without_tpu():
+    """--device tpu must error with a clear message on a CPU-only host, not
+    silently fall back (round-2 verdict item 7: the north-star command must
+    be unambiguous). The test process runs with JAX_PLATFORMS=cpu."""
+    import pytest
+
+    from tpu_ddp.cli.train import build_parser, config_from_args
+
+    args = build_parser().parse_args(
+        ["--device", "tpu", "--synthetic-data", "--epochs", "1"]
+    )
+    with pytest.raises(SystemExit, match="--device tpu"):
+        config_from_args(args)

@@ -196,3 +196,80 @@ def test_cifar10_loader_from_fake_pickles(tmp_path):
     imgs, labels = c10.load_cifar100(str(tmp_path / "c100"), train=False)
     assert imgs.shape == (8, 32, 32, 3)
     assert labels.max() < 100
+
+
+def test_synthetic_hard_no_mean_color_shortcut():
+    """The hard task's class signal must be invisible to per-image channel
+    means (the shortcut that made the easy task saturate): a least-squares
+    probe on channel means should classify at ~chance."""
+    from tpu_ddp.data.cifar10 import synthetic_cifar10_hard
+
+    imgs, labels = synthetic_cifar10_hard(2000, seed=0, label_noise=0.0)
+    feats = imgs.mean(axis=(1, 2))  # (n, 3) per-channel means
+    feats = np.concatenate([feats, np.ones((len(feats), 1))], axis=1)
+    onehot = np.eye(10, dtype=np.float32)[labels]
+    w, *_ = np.linalg.lstsq(feats, onehot, rcond=None)
+    acc = (np.argmax(feats @ w, axis=1) == labels).mean()
+    assert acc < 0.2, f"mean-color probe should be ~chance, got {acc}"
+
+
+def test_synthetic_hard_split_and_noise_semantics():
+    from tpu_ddp.data.cifar10 import synthetic_cifar10_hard
+
+    # Different seeds share one distribution (same centers_seed textures);
+    # distinct draws differ.
+    a_imgs, _ = synthetic_cifar10_hard(64, seed=0, label_noise=0.0)
+    b_imgs, _ = synthetic_cifar10_hard(64, seed=1, label_noise=0.0)
+    assert not np.allclose(a_imgs, b_imgs)
+    # Determinism.
+    a2_imgs, a2_lbl = synthetic_cifar10_hard(64, seed=0, label_noise=0.0)
+    np.testing.assert_array_equal(a_imgs, a2_imgs)
+    # Label noise flips roughly the requested fraction.
+    _, clean = synthetic_cifar10_hard(4000, seed=3, label_noise=0.0)
+    _, noisy = synthetic_cifar10_hard(4000, seed=3, label_noise=0.2)
+    flipped = (clean != noisy).mean()
+    assert 0.1 < flipped < 0.25  # 0.2 * (1 - 1/10) expected ~0.18
+
+
+def test_synthetic_hard_is_learnable_by_conv_net():
+    """A small conv net must beat chance comfortably (the signal is real and
+    shift-invariant) while staying below the easy task's trivial 1.0."""
+    import jax
+
+    from tpu_ddp.data.cifar10 import synthetic_cifar10_hard
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
+    from tpu_ddp.train import create_train_state, make_optimizer, make_train_step
+    from tpu_ddp.train.steps import make_eval_step
+
+    imgs, labels = synthetic_cifar10_hard(
+        1024, seed=0, separation=0.6, label_noise=0.0
+    )
+    t_imgs, t_labels = synthetic_cifar10_hard(
+        256, seed=1, separation=0.6, label_noise=0.0
+    )
+    mesh = create_mesh(MeshSpec(data=-1), jax.devices()[:1])
+    model = NetResDeep(n_chans1=16, n_blocks=2)
+    tx = make_optimizer(lr=0.01, momentum=0.9)
+    state = create_train_state(model, tx, jax.random.key(0))
+    step = make_train_step(model, tx, mesh, donate=False)
+    sharding = batch_sharding(mesh)
+    bs = 128
+    for epoch in range(12):
+        for i in range(0, len(imgs), bs):
+            batch = {
+                "image": imgs[i : i + bs],
+                "label": labels[i : i + bs],
+                "mask": np.ones(min(bs, len(imgs) - i), bool),
+            }
+            state, _ = step(state, jax.device_put(batch, sharding))
+    ev = make_eval_step(model, mesh)(
+        state,
+        jax.device_put(
+            {"image": t_imgs, "label": t_labels,
+             "mask": np.ones(len(t_labels), bool)},
+            sharding,
+        ),
+    )
+    acc = float(ev["correct"]) / float(ev["count"])
+    assert acc > 0.35, f"conv net should beat chance clearly, got {acc}"

@@ -101,3 +101,39 @@ def test_flash_fallback_path_gradients():
         jax.grad(r, argnums=(0, 1, 2))(q, k, v),
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_interpret_gate_uses_device_kind(monkeypatch):
+    """The interpret default must key on the physical device kind, not the
+    backend *name*: experimental TPU platform plugins register under other
+    names (this environment's tunnel is "axon"), and a name-based gate would
+    run the kernels interpreted on the real chip."""
+    import importlib
+
+    # The package re-exports the function over the submodule name, so a
+    # plain ``import tpu_ddp.ops.flash_attention as fa`` binds the function.
+    fa = importlib.import_module("tpu_ddp.ops.flash_attention")
+    from tpu_ddp.parallel import runtime
+
+    class _FakeDev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    # Plugin-named TPU platform: compiled (interpret=False).
+    monkeypatch.setattr(runtime.jax, "default_backend", lambda: "axon")
+    monkeypatch.setattr(
+        runtime.jax, "devices", lambda *a: [_FakeDev("TPU v5 lite")]
+    )
+    assert fa._resolve_interpret(None) is False
+
+    # Plain CPU: interpreted.
+    monkeypatch.setattr(runtime.jax, "default_backend", lambda: "cpu")
+    monkeypatch.setattr(runtime.jax, "devices", lambda *a: [_FakeDev("cpu")])
+    assert fa._resolve_interpret(None) is True
+
+    # Canonical TPU backend name: compiled, no device probe needed.
+    monkeypatch.setattr(runtime.jax, "default_backend", lambda: "tpu")
+    assert fa._resolve_interpret(None) is False
+
+    # Explicit argument always wins.
+    assert fa._resolve_interpret(True) is True

@@ -218,3 +218,37 @@ def test_trainer_steps_per_call(devices, tmp_path):
     assert len(trainer.history["train_loss"]) == 4
     assert trainer.history["train_loss"][-1] < trainer.history["train_loss"][0]
     assert int(trainer.state.step) == 4 * 3
+
+
+def test_eval_loss_exact_across_unequal_shards(devices):
+    """8-device eval loss must equal the single-device eval loss bit-for-bit
+    in spirit (float tolerance) even when shards hold DIFFERENT real counts:
+    the per-shard masked-mean loss is re-weighted by its own count before
+    the psum. A pmean-over-shard-means would fail this with unequal masks —
+    the exact bug class of the reference's val loop (ppe_main_ddp.py:160-166)."""
+    model = NetResDeep(n_blocks=1)
+    tx = make_optimizer()
+    state = create_train_state(model, tx, jax.random.key(0))
+    imgs, labels = synthetic_cifar10(64, seed=9)
+
+    # Unequal real counts per 8-row shard: shard i keeps i+1 real rows.
+    mask = np.zeros(64, bool)
+    for i in range(8):
+        mask[i * 8 : i * 8 + i + 1] = True
+    batch = {"image": imgs, "label": labels, "mask": mask}
+
+    mesh8 = create_mesh(MeshSpec(data=-1))
+    out8 = make_eval_step(model, mesh8)(
+        state, jax.device_put(batch, batch_sharding(mesh8))
+    )
+    mesh1 = create_mesh(MeshSpec(data=-1), jax.devices()[:1])
+    out1 = make_eval_step(model, mesh1)(
+        state, jax.device_put(batch, batch_sharding(mesh1))
+    )
+    assert float(out8["count"]) == float(out1["count"]) == float(mask.sum())
+    np.testing.assert_allclose(
+        float(out8["loss_sum"]), float(out1["loss_sum"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(out8["correct"]), float(out1["correct"]), atol=1e-6
+    )

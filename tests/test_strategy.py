@@ -173,3 +173,59 @@ def test_strategy_rejects_augment(devices):
     )
     with pytest.raises(ValueError, match="augment"):
         Trainer(config)
+
+
+def test_pp_finetune_from_plain_checkpoint(tmp_path):
+    """The §2.4 fine-tune capability (ppe_main_ddp.py:104-111) under the
+    pipeline strategy: a plain-layout ViT checkpoint (trained under dp)
+    restores into PP's stage-stacked layout via to_pipeline_params — the
+    hole the round-2 verdict flagged (build_strategy used to raise here)."""
+    # 1) pretrain a plain ViT under dp, checkpointing as usual.
+    pre = _run_cli(
+        tmp_path, ["--model", "vit_s4"], epochs=1
+    )
+    assert np.isfinite(pre["test_accuracy"])
+
+    # 2) fine-tune from that checkpoint under data=4 x pipeline=2.
+    ft_dir = tmp_path / "ft"
+    from tpu_ddp.cli.train import main
+
+    result = main([
+        "--device", "cpu",
+        "--synthetic-data", "--synthetic-size", "128",
+        "--epochs", "1",
+        "--batch-size", "8",
+        "--log-every-epochs", "1",
+        "--checkpoint-dir", str(ft_dir),
+        "--checkpoint-every-epochs", "1",
+        "--seed", "1",
+        "--model", "vit_s4",
+        "--mesh", "data=4,pipeline=2",
+        "--pretrained-dir", str(tmp_path / "ck"),
+    ])
+    assert np.isfinite(result["test_accuracy"])
+
+
+def test_pp_initial_state_params_restack_exactly(devices):
+    """build_strategy(pp, initial_state=...) must carry the pretrained
+    params into the stage-stacked layout verbatim (fresh optimizer state)."""
+    from tpu_ddp.models.vit import ViT
+    from tpu_ddp.parallel import MeshSpec, create_mesh
+    from tpu_ddp.parallel.pipeline import from_pipeline_params
+    from tpu_ddp.train import create_train_state, make_optimizer
+    from tpu_ddp.train.strategy import build_strategy
+
+    model = ViT(patch_size=8, hidden_dim=32, depth=4, num_heads=2)
+    tx = make_optimizer(lr=1e-2)
+    pretrained = create_train_state(model, tx, jax.random.key(42))
+    mesh = create_mesh(MeshSpec(data=2, pipeline=4))
+    strategy = build_strategy(
+        "pp", mesh, model, tx, jax.random.key(0), initial_state=pretrained
+    )
+    roundtrip = from_pipeline_params(
+        jax.device_get(strategy.state.params), model.depth
+    )
+    for a, b in zip(
+        jax.tree.leaves(pretrained.params), jax.tree.leaves(roundtrip)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -130,6 +130,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="batch-infer the test set and dump predictions "
                         "(ppe_main_ddp.py:310-396)")
     p.add_argument("--synthetic-size", type=int, default=2048)
+    p.add_argument("--synthetic-task", choices=["easy", "hard"],
+                   default="easy",
+                   help="easy: color blobs (saturates at 1.0); hard: "
+                        "shift-invariant zero-mean textures + train-label "
+                        "noise (bounded ceiling — recipe quality visible)")
+    p.add_argument("--synthetic-label-noise", type=float, default=0.1,
+                   help="hard task: fraction of TRAIN labels flipped to "
+                        "uniform-random classes")
     p.add_argument("--steps-per-call", type=int, default=1,
                    help=">1 fuses K optimizer steps into one dispatch "
                         "(lax.scan) — amortizes host overhead on small "
@@ -145,6 +153,22 @@ def config_from_args(args) -> TrainConfig:
 
     if args.device == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    elif args.device == "tpu":
+        # Demand a physical TPU — fail loudly instead of silently training
+        # on whatever platform JAX picked (the north-star command must be
+        # unambiguous). Device-KIND predicate: covers experimental TPU
+        # platform plugins registered under other names (e.g. "axon").
+        from tpu_ddp.parallel.runtime import is_tpu_device
+
+        if not is_tpu_device():
+            try:
+                platform = jax.default_backend()
+            except RuntimeError:
+                platform = "<no backend>"
+            raise SystemExit(
+                f"--device tpu: default platform is {platform!r}, not a "
+                "TPU. Check the TPU runtime, or pass --device cpu/auto."
+            )
     if args.compilation_cache_dir:
         jax.config.update("jax_compilation_cache_dir",
                           args.compilation_cache_dir)
@@ -225,6 +249,8 @@ def config_from_args(args) -> TrainConfig:
         plot_curves=args.plot_curves,
         dump_predictions=args.dump_predictions,
         synthetic_size=args.synthetic_size,
+        synthetic_task=args.synthetic_task,
+        synthetic_label_noise=args.synthetic_label_noise,
         steps_per_call=args.steps_per_call,
         prefetch_depth=args.prefetch_depth,
     )

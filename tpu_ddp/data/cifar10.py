@@ -135,6 +135,72 @@ def synthetic_cifar10(
     return imgs, labels
 
 
+def synthetic_cifar10_hard(
+    n: int = 2048,
+    num_classes: int = 10,
+    seed: int = 0,
+    centers_seed: int = 0,
+    *,
+    separation: float = 0.3,
+    label_noise: float = 0.1,
+    max_shift: int = 8,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """A NON-trivial CIFAR-10-shaped synthetic task (round-2 verdict item 4:
+    the easy generator's constant-color classes saturate at 100% in a few
+    epochs and demonstrate nothing about training quality).
+
+    Construction:
+    - each class is a fixed low-frequency, ZERO-MEAN texture (FFT low-pass
+      of white noise, mean removed per channel) — so per-image mean color
+      carries no class signal and a global-average-pool linear probe sits
+      at chance;
+    - the texture is circularly shifted by a random per-sample 2-D offset
+      in ``[0, max_shift)`` — the class is translation-jittered, which
+      convolution + pooling can absorb and a fixed-position template
+      cannot (and which random-crop augmentation is aligned with);
+    - additive unit-variance Gaussian noise at ``separation`` signal
+      amplitude sets the difficulty;
+    - ``label_noise`` flips that fraction of labels to uniform-random
+      classes, capping achievable accuracy at roughly
+      ``1 - label_noise * (1 - 1/num_classes)`` — so recipe quality shows
+      up as distance from a known ceiling, not as 1.0-vs-1.0.
+
+    Same split semantics as ``synthetic_cifar10``: textures depend only on
+    ``centers_seed``, so train/test drawn with different ``seed`` share one
+    distribution.
+    """
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
+
+    crng = np.random.default_rng(centers_seed)
+    tex = crng.normal(size=(num_classes, 32, 32, 3)).astype(np.float32)
+    # Low-pass in frequency space: keep only the lowest 6 spatial
+    # frequencies per axis so the texture has broad structure (informative
+    # under crops), then re-normalize to zero mean / unit power.
+    f = np.fft.rfft2(tex, axes=(1, 2))
+    keep = 6
+    f[:, keep:-keep or None, :] = 0
+    f[:, :, keep:] = 0
+    tex = np.fft.irfft2(f, s=(32, 32), axes=(1, 2)).astype(np.float32)
+    tex -= tex.mean(axis=(1, 2), keepdims=True)
+    tex /= np.sqrt((tex ** 2).mean(axis=(1, 2, 3), keepdims=True))
+
+    shifts = rng.integers(0, max(max_shift, 1), size=(n, 2))
+    rows = (np.arange(32)[None, :, None] + shifts[:, 0, None, None]) % 32
+    cols = (np.arange(32)[None, None, :] + shifts[:, 1, None, None]) % 32
+    shifted = tex[labels][np.arange(n)[:, None, None], rows, cols, :]
+
+    imgs = rng.normal(0.0, 1.0, size=(n, 32, 32, 3)).astype(np.float32)
+    imgs += separation * shifted
+
+    if label_noise > 0:
+        flip = rng.random(n) < label_noise
+        labels = np.where(
+            flip, rng.integers(0, num_classes, size=n), labels
+        ).astype(np.int32)
+    return imgs, labels
+
+
 def synthetic_multilabel(
     n: int = 512, num_classes: int = 3, seed: int = 0, centers_seed: int = 0
 ) -> Tuple[np.ndarray, np.ndarray]:

@@ -290,8 +290,13 @@ def _flash_backward(q, k, v, o, lse, g, *, block_q: int, block_k: int,
 
 
 def _resolve_interpret(interpret):
+    """interpret=None defaults to compiled (mosaic) on physical TPUs —
+    keyed on device KIND via the shared predicate, not backend name, so
+    plugin-registered TPU platforms (e.g. "axon") get the real kernels."""
     if interpret is None:
-        return jax.default_backend() != "tpu"
+        from tpu_ddp.parallel.runtime import is_tpu_device
+
+        return not is_tpu_device()
     return interpret
 
 

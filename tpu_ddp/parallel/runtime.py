@@ -63,9 +63,13 @@ def scrubbed_cpu_env(n_virtual_devices: int = 1) -> dict:
     existing ``--xla_force_host_platform_device_count`` (XLA honors the LAST
     duplicate, so stale values must be stripped, not just appended after).
 
-    The single source of truth for this scrub — ``bench.py``'s CPU fallback
-    and ``__graft_entry__``'s dryrun re-exec both use it; keep future plugin
-    env vars to scrub HERE."""
+    NOTE — this scrub exists in THREE places that must be kept in sync:
+    here (library callers / tests), ``bench.py::_scrubbed_cpu_env`` and
+    ``__graft_entry__.py::_scrubbed_child_env``. The latter two are
+    deliberate stdlib-only inline copies: their parent processes must not
+    import tpu_ddp (which pulls in jax — this environment's platform plugin
+    has hung backend init from shallow entry points). When a new plugin env
+    var that can wedge backend init appears, add it to ALL THREE."""
     import os
     import re
 
@@ -82,6 +86,25 @@ def scrubbed_cpu_env(n_virtual_devices: int = 1) -> dict:
         + f" --xla_force_host_platform_device_count={n_virtual_devices}"
     ).strip()
     return env
+
+
+def is_tpu_device() -> bool:
+    """True when the default device is physically a TPU — including
+    experimental platform plugins whose *backend name* is not "tpu" (this
+    environment's tunnel registers as "axon") but whose device kind says
+    TPU. The single in-tree copy of this rule: gating on backend name alone
+    silently mis-classifies plugin-registered TPUs (round-2 verdict: flash
+    attention would have run interpreted on the real chip). Used by the
+    Pallas interpret gate, the CLI's ``--device tpu`` check, the trainer's
+    H2D-copy rule, and bench's attention gate. Touches the backend — never
+    call before platform selection."""
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        kind = jax.devices()[0].device_kind
+    except RuntimeError:
+        return False
+    return "tpu" in kind.lower()
 
 
 def is_primary_process() -> bool:

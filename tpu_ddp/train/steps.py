@@ -248,21 +248,24 @@ def make_eval_step(
         logits = model.apply(variables, batch["image"], train=False)
         mask = batch.get("mask")
         loss = loss_fn(logits, batch["label"], mask)
+        shard_count = (
+            mask.astype(jnp.float32).sum()
+            if mask is not None
+            else jnp.asarray(float(logits.shape[0]))
+        )
         if compute_accuracy:
-            correct, count = masked_accuracy(logits, batch["label"], mask)
+            correct, _ = masked_accuracy(logits, batch["label"], mask)
         else:
             correct = jnp.zeros(())
-            count = (
-                mask.astype(jnp.float32).sum()
-                if mask is not None
-                else jnp.asarray(float(logits.shape[0]))
-            )
         return {
             "correct": lax.psum(correct, data_axis),
-            "count": lax.psum(count, data_axis),
-            # per-shard mean loss averaged over shards, weighted equally like
-            # the train metric; exact enough for equal-size shards
-            "loss_sum": lax.pmean(loss, data_axis) * lax.psum(count, data_axis),
+            "count": lax.psum(shard_count, data_axis),
+            # EXACT sum of per-sample losses: the per-shard (masked-mean)
+            # loss re-weighted by ITS OWN real count before the psum — with
+            # drop_last=False padding, shards hold different real counts, so
+            # a pmean over shard means would mis-weight exactly the way the
+            # reference's val loop mis-measured (ppe_main_ddp.py:160-166).
+            "loss_sum": lax.psum(loss * shard_count, data_axis),
         }
 
     sharded = jax.shard_map(

@@ -222,8 +222,9 @@ def build_strategy(
     augmentation pipeline are the flagship and predate this router.)
 
     ``initial_state``: an unsharded TrainState to lay out instead of a fresh
-    init (the fine-tune path). PP converts params to its stage-stacked
-    layout itself and does not accept one.
+    init (the fine-tune path). PP restacks its plain-layout params into the
+    stage-major pipeline layout (``to_pipeline_params``) with fresh
+    optimizer state.
     """
     from tpu_ddp.parallel.partitioning import shard_train_state
     from tpu_ddp.train.steps import make_eval_step, make_predict_step
@@ -265,14 +266,24 @@ def build_strategy(
             create_pp_train_state,
             from_pipeline_params,
             make_pp_train_step,
+            to_pipeline_params,
         )
 
         if initial_state is not None:
-            raise ValueError(
-                "pretrained restore into the pipeline layout is not "
-                "supported yet; fine-tune with dp/fsdp/tp instead"
+            # Fine-tune path: restack the plain-layout checkpoint params
+            # into the stage-major pipeline layout; optimizer state is
+            # re-initialized on the converted tree (fresh momentum, the
+            # standard fine-tune semantics — matches the non-PP modes,
+            # which also start tx fresh after a pretrained restore).
+            pp_params = to_pipeline_params(initial_state.params, model.depth)
+            state = TrainState(
+                step=initial_state.step,
+                params=pp_params,
+                batch_stats=initial_state.batch_stats,
+                opt_state=tx.init(pp_params),
             )
-        state = create_pp_train_state(model, tx, rng)
+        else:
+            state = create_pp_train_state(model, tx, rng)
         step, shardings = make_pp_train_step(
             model, tx, mesh, state,
             n_microbatches=n_microbatches, loss_fn=loss_fn,

@@ -38,6 +38,11 @@ class TrainConfig:
     dataset: str = "cifar10"              # cifar10 | cifar100
     synthetic_data: bool = False          # no torchvision download path
     synthetic_size: int = 2048
+    synthetic_task: str = "easy"          # easy (color blobs, saturates at
+                                          # 1.0) | hard (shifted zero-mean
+                                          # textures + label noise: bounded
+                                          # ceiling, recipe quality visible)
+    synthetic_label_noise: float = 0.1    # hard task: train-label flip rate
     epochs: int = 99                      # range(1,100), main.py:30
     per_shard_batch: int = 32             # per-process bs, main.py:61
     lr: float = 1e-2                      # main.py:27
@@ -138,11 +143,29 @@ def load_dataset(c: TrainConfig):
     Trainer and the k-fold CV driver (which re-splits the train set itself,
     the reference's ``cv_mode`` path, ``ppe_main_ddp.py:91-93``)."""
     if c.synthetic_data:
-        from tpu_ddp.data.cifar10 import synthetic_cifar10, synthetic_multilabel
+        from tpu_ddp.data.cifar10 import (
+            synthetic_cifar10,
+            synthetic_cifar10_hard,
+            synthetic_multilabel,
+        )
 
-        gen = synthetic_multilabel if c.loss == "bce" else synthetic_cifar10
-        train = gen(c.synthetic_size, c.num_classes, c.seed)
-        test = gen(max(c.synthetic_size // 5, 64), c.num_classes, c.seed + 1)
+        test_size = max(c.synthetic_size // 5, 64)
+        if c.loss == "bce":
+            train = synthetic_multilabel(c.synthetic_size, c.num_classes, c.seed)
+            test = synthetic_multilabel(test_size, c.num_classes, c.seed + 1)
+        elif c.synthetic_task == "hard":
+            # Label noise corrupts TRAIN only; the clean test set makes the
+            # recipe-quality gap readable against the noise-free ceiling.
+            train = synthetic_cifar10_hard(
+                c.synthetic_size, c.num_classes, c.seed,
+                label_noise=c.synthetic_label_noise,
+            )
+            test = synthetic_cifar10_hard(
+                test_size, c.num_classes, c.seed + 1, label_noise=0.0
+            )
+        else:
+            train = synthetic_cifar10(c.synthetic_size, c.num_classes, c.seed)
+            test = synthetic_cifar10(test_size, c.num_classes, c.seed + 1)
     else:
         from tpu_ddp.data.cifar10 import load_cifar10, load_cifar100
 
@@ -493,10 +516,11 @@ class Trainer:
         # ignores may_alias=False) would otherwise see slot reuse corrupt
         # batches the compiled step hasn't consumed yet. Unknown backends
         # fail SAFE (copy).
-        kind = jax.devices()[0].device_kind.lower()
+        from tpu_ddp.parallel.runtime import is_tpu_device
+
         real_h2d = (
             jax.default_backend() in ("tpu", "gpu", "cuda", "rocm")
-            or "tpu" in kind
+            or is_tpu_device()
         )
         host_copy = pf.reusable_slots and not real_h2d
 
