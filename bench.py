@@ -53,11 +53,15 @@ import subprocess
 import sys
 import time
 
-# Dispatch-per-step path (reference pattern, main.py:32-41) on a single
-# TPU chip — the denominator for vs_baseline. Falls back to the builder's
-# round-1 session measurement until benchmarks/bench_tpu.json (task: record
-# a driver-independent on-chip number) replaces it.
-BASELINE_IMAGES_PER_SEC_PER_CHIP = 16892.0
+# vs_baseline denominator: the dispatch-per-step path (the reference's
+# per-batch hot-loop pattern, main.py:32-41) on the SAME hardware. In full
+# (non-quick) mode it is MEASURED in the same run (`baseline` record below)
+# — self-contained evidence, per the round-2 verdict. This constant is only
+# the fallback denominator for the early headline line and for quick/CPU
+# mode, where measuring the baseline would blow the budget; it came from a
+# builder session on a TPU v5e chip and is clearly labeled when used
+# (`vs_baseline_source`).
+FALLBACK_BASELINE_IMAGES_PER_SEC_PER_CHIP = 16892.0
 
 TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET_S", 540))
 _PROBE_TIMEOUT_S = 60
@@ -181,6 +185,51 @@ def _bench_flagship(quick: bool) -> dict:
         "dtype": "float32",
         "per_shard_batch": per_shard,
         "steps_per_call": steps_per_call,
+        "n_chips": n_chips,
+    }
+
+
+def _bench_dispatch_baseline() -> dict:
+    """The reference's execution pattern — ONE optimizer step per host
+    dispatch (``main.py:32-41``'s per-batch loop) — on the same model,
+    per-shard batch, and hardware as the flagship. Measured in the same
+    bench run so ``vs_baseline`` is self-contained evidence rather than a
+    constant."""
+    import jax
+    import numpy as np
+
+    from tpu_ddp.data import synthetic_cifar10
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
+    from tpu_ddp.train import create_train_state, make_optimizer, make_train_step
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = create_mesh(MeshSpec(data=-1), devices)
+    model = NetResDeep()
+    tx = make_optimizer(lr=1e-2)
+    state = create_train_state(model, tx, jax.random.key(0))
+    step = make_train_step(model, tx, mesh)
+
+    per_shard = 32
+    global_batch = per_shard * n_chips
+    imgs, labels = synthetic_cifar10(global_batch, seed=0)
+    batch = {
+        "image": imgs.astype(np.float32),
+        "label": labels,
+        "mask": np.ones(global_batch, bool),
+    }
+    batch = jax.device_put(batch, batch_sharding(mesh))
+    _, calls, elapsed = _measure(
+        step, state, batch, target_seconds=4.0, max_calls=400
+    )
+    per_chip = calls * global_batch / elapsed / n_chips
+    return {
+        "images_per_sec_per_chip": round(per_chip, 1),
+        "model": "netresdeep",
+        "dtype": "float32",
+        "per_shard_batch": per_shard,
+        "steps_per_call": 1,
         "n_chips": n_chips,
     }
 
@@ -333,8 +382,9 @@ def child_main(quick: bool) -> None:
         "value": per_chip if per_chip is not None else 0.0,
         "unit": "images/sec/chip",
         "vs_baseline": round(
-            (per_chip or 0.0) / BASELINE_IMAGES_PER_SEC_PER_CHIP, 3
+            (per_chip or 0.0) / FALLBACK_BASELINE_IMAGES_PER_SEC_PER_CHIP, 3
         ),
+        "vs_baseline_source": "fallback_constant",
         "mfu": None if mfu_val is None else round(mfu_val, 4),
         "backend": backend,
         "device_kind": kind,
@@ -347,7 +397,27 @@ def child_main(quick: bool) -> None:
     if quick:
         return
     out = dict(headline)
+    # The reference's dispatch-per-step pattern on the same hardware: the
+    # measured vs_baseline denominator (round-2 verdict: the constant was
+    # unverifiable).
     if time.time() < deadline - 60:
+        try:
+            base = _bench_dispatch_baseline()
+        except Exception:
+            base = {"error": traceback.format_exc(limit=2).strip()}
+    else:
+        base = {"skipped": "deadline"}
+    out["baseline_dispatch_per_step"] = base
+    base_v = base.get("images_per_sec_per_chip")
+    if per_chip and base_v:
+        out["vs_baseline"] = round(per_chip / base_v, 3)
+        out["vs_baseline_source"] = "measured_same_run"
+    # bf16 is EMULATED on CPU (round 2: the ResNet-50 bf16 config ran
+    # >1200s there) — the compute-bound sub-bench is only meaningful, and
+    # only affordable, on a real accelerator.
+    if not _is_tpu_child():
+        compute = {"skipped": "non-TPU backend (bf16 emulated)"}
+    elif time.time() < deadline - 60:
         try:
             compute = _bench_compute_bound(quick)
         except Exception:

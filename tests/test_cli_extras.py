@@ -156,3 +156,18 @@ def test_device_tpu_fails_loudly_without_tpu():
     )
     with pytest.raises(SystemExit, match="--device tpu"):
         config_from_args(args)
+
+
+def test_netresdeep_width_depth_flags():
+    """--n-chans1/--n-blocks mirror the reference's NetResDeep ctor args
+    (model/resnet.py:5): the built model must actually change size."""
+    from tpu_ddp.cli.train import build_parser, config_from_args
+    from tpu_ddp.train.trainer import build_model
+
+    args = build_parser().parse_args(
+        ["--device", "cpu", "--synthetic-data",
+         "--n-chans1", "16", "--n-blocks", "2"]
+    )
+    config = config_from_args(args)
+    model = build_model(config)
+    assert model.n_chans1 == 16 and model.n_blocks == 2

@@ -73,6 +73,7 @@ def _run_cli(tmp_path, extra, epochs=1, resume=False):
 STRATEGY_CLI_FLAGS = {
     "fsdp": ["--parallelism", "fsdp", "--model", "resnet18"],
     "tp": ["--mesh", "data=2,model=4", "--model", "vit_s4"],
+    "fsdp_tp": ["--parallelism", "fsdp_tp", "--mesh", "data=2,model=4", "--model", "vit_s4"],
     "pp": ["--mesh", "data=4,pipeline=2", "--model", "vit_s4"],
     "sp": ["--mesh", "data=4,sequence=2", "--model", "vit_s4"],
     "ep": ["--mesh", "data=4,expert=2", "--model", "vit_moe_s4"],

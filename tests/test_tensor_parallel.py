@@ -143,3 +143,77 @@ def test_tp_mesh_shapes(devices, n_data, n_model):
     sharded = shard_train_state(state, shardings)
     _, metrics = step(sharded, _batch(8 * n_data))
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_compose_fsdp_over_tp_specs():
+    """FSDP x TP composition: the data axis lands on a FREE dimension only,
+    never on one the TP rules already shard; small/indivisible params keep
+    their spec."""
+    from tpu_ddp.parallel.partitioning import compose_fsdp_over
+
+    params = {
+        "qkv_kernel": np.zeros((64, 96), np.float32),   # TP: P(None,'model')
+        "tiny_bias": np.zeros((5,), np.float32),        # indivisible by 2
+        "plain_kernel": np.zeros((64, 64), np.float32),  # no TP rule
+    }
+    tp = {
+        "qkv_kernel": P(None, "model"),
+        "tiny_bias": P(),
+        "plain_kernel": P(),
+    }
+    out = compose_fsdp_over(tp, params, "data", 2)
+    assert out["qkv_kernel"] == P("data", "model")
+    assert out["tiny_bias"] == P()
+    assert out["plain_kernel"] == P("data", None)
+
+
+def test_fsdp_tp_step_matches_unsharded_math(devices):
+    """2-D fsdp_tp on data=2 x model=4: same params/loss as the unsharded
+    single-device step, and at least one tensor physically laid out over
+    BOTH axes."""
+    from tpu_ddp.parallel.partitioning import shard_train_state
+    from tpu_ddp.parallel.tensor_parallel import make_fsdp_tp_train_step
+
+    mesh = create_mesh(MeshSpec(data=2, model=4))
+    model = ViT(patch_size=8, hidden_dim=64, depth=2, num_heads=4)
+    tx = make_optimizer(lr=0.05, momentum=0.9)
+    state = create_train_state(model, tx, jax.random.key(0))
+    ref_state = jax.tree.map(lambda x: np.asarray(x), state)
+
+    step, shardings = make_fsdp_tp_train_step(model, tx, mesh, state)
+    sharded = shard_train_state(state, shardings)
+    # Some param is sharded over both mesh axes.
+    specs = [
+        s.spec for s in jax.tree.leaves(
+            shardings.params,
+            is_leaf=lambda x: hasattr(x, "spec"),
+        )
+    ]
+    assert any(
+        "data" in tuple(sp) and "model" in tuple(sp) for sp in specs
+    ), specs
+
+    imgs, labels = synthetic_cifar10(2 * 8, seed=7)
+    batch = {"image": imgs, "label": labels, "mask": np.ones(16, bool)}
+    new_state, metrics = step(sharded, batch)
+
+    # Unsharded single-device reference step.
+    from tpu_ddp.train import make_train_step
+
+    mesh1 = create_mesh(MeshSpec(data=-1), jax.devices()[:1])
+    ref_step = make_train_step(model, tx, mesh1, donate=False)
+    from tpu_ddp.parallel import batch_sharding
+
+    ref_new, ref_metrics = ref_step(
+        jax.tree.map(jnp.asarray, ref_state),
+        jax.device_put(batch, batch_sharding(mesh1)),
+    )
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(ref_metrics["loss"]), rtol=2e-5
+    )
+    for a, b in zip(
+        jax.tree.leaves(new_state.params), jax.tree.leaves(ref_new.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+        )

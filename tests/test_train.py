@@ -137,3 +137,63 @@ def test_cli_config_mapping(devices):
     assert cfg.prefetch_depth == 0
     assert cfg.freeze_prefixes == ("head", "fc")
     assert cfg.reshuffle_each_epoch is False
+
+
+def test_grad_accum_matches_full_batch(devices):
+    """K-microbatch gradient accumulation must produce the SAME update as
+    the full-batch step (exact for a BN-free model with equal microbatch
+    counts: the per-microbatch pmean-before-AD sync is preserved and the
+    outer mean commutes with AD)."""
+    import numpy as np
+
+    from tpu_ddp.data import synthetic_cifar10
+    from tpu_ddp.models.vit import ViT
+    from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
+    from tpu_ddp.train import create_train_state, make_optimizer, make_train_step
+    from tpu_ddp.train.steps import make_grad_accum_train_step
+
+    mesh = create_mesh(MeshSpec(data=-1))
+    model = ViT(patch_size=8, hidden_dim=32, depth=2, num_heads=2)
+    tx = make_optimizer(lr=0.05, momentum=0.9)
+    state = create_train_state(model, tx, jax.random.key(0))
+    imgs, labels = synthetic_cifar10(8 * 16, seed=11)
+    batch = {
+        "image": imgs, "label": labels, "mask": np.ones(len(labels), bool)
+    }
+    sharding = batch_sharding(mesh)
+    batch = jax.device_put(batch, sharding)
+
+    full = make_train_step(model, tx, mesh, donate=False)
+    accum = make_grad_accum_train_step(mesh=mesh, model=model, tx=tx,
+                                       accum_steps=4, donate=False)
+    s_full, m_full = full(state, batch)
+    s_acc, m_acc = accum(state, batch)
+    np.testing.assert_allclose(
+        float(m_full["loss"]), float(m_acc["loss"]), rtol=1e-5
+    )
+    for a, b in zip(
+        jax.tree.leaves(s_full.params), jax.tree.leaves(s_acc.params)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-6, rtol=1e-5
+        )
+
+
+def test_grad_accum_cli_and_guards(tmp_path, devices):
+    from tpu_ddp.cli.train import main
+
+    result = main([
+        "--device", "cpu", "--synthetic-data", "--synthetic-size", "128",
+        "--epochs", "1", "--batch-size", "8", "--grad-accum-steps", "2",
+        "--log-every-epochs", "1",
+    ])
+    assert np.isfinite(result["test_accuracy"])
+
+    import pytest
+
+    with pytest.raises(ValueError, match="opposite trades"):
+        main([
+            "--device", "cpu", "--synthetic-data", "--synthetic-size", "128",
+            "--epochs", "1", "--batch-size", "8", "--grad-accum-steps", "2",
+            "--steps-per-call", "4",
+        ])

@@ -40,11 +40,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--n-devices", type=int, default=None,
                    help="1 == the main_no_ddp.py single-device baseline")
     p.add_argument("--parallelism",
-                   choices=["dp", "fsdp", "tp", "pp", "sp", "ep"],
+                   choices=["dp", "fsdp", "tp", "fsdp_tp", "pp", "sp", "ep"],
                    default=None,
                    help="scale-out strategy: dp (default), fsdp (ZeRO-3 "
-                        "sharded state), tp (Megatron tensor parallel), pp "
-                        "(GPipe pipeline), sp (sequence parallel + ring "
+                        "sharded state), tp (Megatron tensor parallel), "
+                        "fsdp_tp (2-D: TP over model + ZeRO-3 over data), "
+                        "pp (GPipe pipeline), sp (sequence parallel + ring "
                         "attention), ep (expert parallel MoE). Default: "
                         "inferred from --mesh, else dp")
     p.add_argument("--mesh", default=None, metavar="AXES",
@@ -61,6 +62,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="flash = the Pallas blockwise online-softmax kernel "
                         "(forward AND backward in-kernel), ViT-family "
                         "models; sp mode uses ring attention regardless")
+    p.add_argument("--n-chans1", type=int, default=32,
+                   help="NetResDeep width — the reference's n_chans1 ctor "
+                        "arg (model/resnet.py:5)")
+    p.add_argument("--n-blocks", type=int, default=10,
+                   help="NetResDeep depth — the reference's n_blocks ctor arg")
     p.add_argument("--untied-blocks", action="store_true",
                    help="independent ResBlocks (the reference's list-repeat "
                         "quirk ties them; see SURVEY.md §2.2)")
@@ -142,6 +148,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help=">1 fuses K optimizer steps into one dispatch "
                         "(lax.scan) — amortizes host overhead on small "
                         "models; semantics unchanged")
+    p.add_argument("--grad-accum-steps", type=int, default=1,
+                   help=">1 splits each optimizer step into K sequential "
+                        "microbatches (gradient accumulation): same "
+                        "semantics, ~1/K activation memory — the big-"
+                        "global-batch knob")
     p.add_argument("--prefetch-depth", type=int, default=2,
                    help="batches assembled ahead on the native host "
                         "prefetcher (C++ ring buffer; 0 disables)")
@@ -227,6 +238,8 @@ def config_from_args(args) -> TrainConfig:
         compute_dtype=args.compute_dtype,
         remat=args.remat,
         model=args.model,
+        n_chans1=args.n_chans1,
+        n_blocks=args.n_blocks,
         tied_blocks=not args.untied_blocks,
         attention=args.attention,
         num_classes=(
@@ -252,6 +265,7 @@ def config_from_args(args) -> TrainConfig:
         synthetic_task=args.synthetic_task,
         synthetic_label_noise=args.synthetic_label_noise,
         steps_per_call=args.steps_per_call,
+        grad_accum_steps=args.grad_accum_steps,
         prefetch_depth=args.prefetch_depth,
     )
 

@@ -85,6 +85,34 @@ def fsdp_specs(params: Any, axis: str, axis_size: int) -> Any:
     return jax.tree.map(pick, params)
 
 
+def compose_fsdp_over(
+    param_specs: Any, params: Any, axis: str, axis_size: int
+) -> Any:
+    """Layer ZeRO-3 scattering over an EXISTING spec tree (the scaling-book
+    2-D layout, e.g. Megatron TP over ``model`` + FSDP over ``data``): for
+    each param, shard its largest still-unsharded, axis-size-divisible
+    dimension over ``axis``. Params already fully sharded, too small, or
+    with no divisible free dim keep their spec unchanged — correctness
+    never depends on the extra scatter, only memory does."""
+
+    def pick(spec, leaf):
+        shape = getattr(leaf, "shape", ())
+        if not shape or max(shape) < 2 * axis_size:
+            return spec
+        merged = list(spec) + [None] * (len(shape) - len(spec))
+        free = [d for d in range(len(shape)) if merged[d] is None]
+        for d in sorted(free, key=lambda d: -shape[d]):
+            if shape[d] % axis_size == 0:
+                merged[d] = axis
+                return P(*merged)
+        return spec
+
+    return jax.tree.map(
+        pick, param_specs, params,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 def opt_state_specs(opt_state: Any, param_specs: Any) -> Any:
     """Specs for an optax state tree: leaves whose path ends with a param's
     path (momentum/trace/mu/nu mirror the param tree) inherit that param's

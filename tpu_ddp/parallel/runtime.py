@@ -98,11 +98,13 @@ def is_tpu_device() -> bool:
     Pallas interpret gate, the CLI's ``--device tpu`` check, the trainer's
     H2D-copy rule, and bench's attention gate. Touches the backend — never
     call before platform selection."""
-    if jax.default_backend() == "tpu":
-        return True
     try:
+        if jax.default_backend() == "tpu":
+            return True
         kind = jax.devices()[0].device_kind
     except RuntimeError:
+        # No backend could initialize at all: definitionally not a TPU —
+        # callers (e.g. --device tpu) turn False into their own clear error.
         return False
     return "tpu" in kind.lower()
 

@@ -30,6 +30,7 @@ from tpu_ddp.train.losses import combine_aux_loss
 from tpu_ddp.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from tpu_ddp.parallel.partitioning import (
     PartitionRule,
+    compose_fsdp_over,
     fsdp_specs,
     specs_for_params,
     shard_train_state,
@@ -148,6 +149,37 @@ def make_tp_train_step(
 
     Returns (step, state_shardings)."""
     param_specs = specs_for_params(state_template.params, rules)
+    build = make_sharded_train_step(
+        model, tx, mesh, param_specs,
+        data_axis=data_axis, loss_fn=loss_fn, donate=donate,
+        aux_weight=aux_weight,
+    )
+    return build(state_template)
+
+
+def make_fsdp_tp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    state_template: TrainState,
+    *,
+    rules=VIT_TP_RULES,
+    data_axis: str = DATA_AXIS,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+    aux_weight: float = 0.01,
+):
+    """2-D FSDP x TP on a ``data x model`` mesh — the scaling-book layout:
+    every big tensor is Megatron-sharded over ``model`` (its collectives
+    ride the inner mesh axis) AND ZeRO-3-scattered over ``data`` on a
+    remaining dimension, so param + optimizer memory drops by ~(data_size x
+    model_size) while the batch shards over ``data`` as usual. The XLA
+    partitioner inserts the per-block all-gathers/reduce-scatters for both
+    axes from the annotations alone. Returns (step, state_shardings)."""
+    tp_specs = specs_for_params(state_template.params, rules)
+    param_specs = compose_fsdp_over(
+        tp_specs, state_template.params, data_axis, mesh.shape[data_axis]
+    )
     build = make_sharded_train_step(
         model, tx, mesh, param_specs,
         data_axis=data_axis, loss_fn=loss_fn, donate=donate,

@@ -8,7 +8,12 @@ region is ONE jitted SPMD function with ``lax.pmean`` where NCCL sat
 
 from tpu_ddp.train.state import TrainState, create_train_state
 from tpu_ddp.train.losses import cross_entropy_loss, masked_accuracy
-from tpu_ddp.train.steps import make_train_step, make_scan_train_step, make_eval_step
+from tpu_ddp.train.steps import (
+    make_train_step,
+    make_scan_train_step,
+    make_grad_accum_train_step,
+    make_eval_step,
+)
 from tpu_ddp.train.optim import make_optimizer
 from tpu_ddp.train.trainer import Trainer, TrainConfig
 
@@ -19,6 +24,7 @@ __all__ = [
     "masked_accuracy",
     "make_train_step",
     "make_scan_train_step",
+    "make_grad_accum_train_step",
     "make_eval_step",
     "make_optimizer",
     "Trainer",

@@ -231,6 +231,128 @@ def make_scan_train_step(
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
 
+def make_grad_accum_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    accum_steps: int,
+    data_axis: str = DATA_AXIS,
+    loss_fn: Callable = cross_entropy_loss,
+    donate: bool = True,
+    compute_accuracy: bool = True,
+    remat: bool = False,
+    aux_weight: float = 0.01,
+) -> Callable[[TrainState, Batch], tuple]:
+    """ONE optimizer step over a global batch too large to activate at
+    once: each shard splits its rows into ``accum_steps`` microbatches,
+    accumulates gradients over them with ``lax.scan`` (activations for only
+    one microbatch live at a time — the classic memory/throughput trade the
+    reference cannot express; its global batch is rigidly
+    per-process-batch × world size, ``main.py:61``), then applies a single
+    optax update with the average gradient.
+
+    Semantics: with equal real counts per microbatch the accumulated
+    gradient equals the full-batch gradient exactly (each microbatch's
+    cross-shard pmean-before-AD sync is preserved; the outer mean over
+    microbatches commutes with AD). With masked/unequal microbatches the
+    average weights microbatches equally — same approximation class as
+    every accumulation implementation. BatchNorm stats chain through the
+    scan (each microbatch normalizes by its own statistics, as the
+    reference's per-replica BN does per step).
+
+    step(state, batch) -> (state, metrics): batch is the usual global
+    {image, label, mask}; per-shard rows must divide by ``accum_steps``.
+    """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def apply_model(params, batch_stats, images):
+        return model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            images,
+            train=True,
+            mutable=["batch_stats", "aux_loss"],
+        )
+
+    if remat:
+        apply_model = jax.checkpoint(apply_model)
+
+    def compute_loss(params, batch_stats, micro):
+        logits, mutated = apply_model(params, batch_stats, micro["image"])
+        task = loss_fn(logits, micro["label"], micro.get("mask"))
+        loss, aux = combine_aux_loss(task, mutated, aux_weight)
+        loss = lax.pmean(loss, data_axis)  # grad sync, as in _make_shard_step
+        return loss, (mutated.get("batch_stats", batch_stats), logits, task, aux)
+
+    def shard_step(state: TrainState, batch: Batch):
+        b = batch["image"].shape[0]
+        if b % accum_steps:
+            raise ValueError(
+                f"per-shard batch {b} not divisible by accum_steps "
+                f"{accum_steps}"
+            )
+        micros = jax.tree.map(
+            lambda x: x.reshape((accum_steps, b // accum_steps) + x.shape[1:]),
+            batch,
+        )
+        grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
+        zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+
+        def accum(carry, micro):
+            grads_acc, stats, correct, count, loss_sum, aux_sum = carry
+            (_, (new_stats, logits, task, aux)), grads = grad_fn(
+                state.params, stats, micro
+            )
+            grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+            c, n = masked_accuracy(logits, micro["label"], micro.get("mask"))
+            aux_term = jnp.zeros(()) if aux is None else aux
+            return (
+                grads_acc, new_stats, correct + c, count + n,
+                loss_sum + task, aux_sum + aux_term,
+            ), None
+
+        # Values computed from shard-local data (metric scalars, fresh BN
+        # stats) are VARYING over the data axis under shard_map; the carry
+        # inits (zeros / the replicated incoming stats) must match that
+        # type. Gradients stay unvarying: AD of the pmean'd loss inserts
+        # the psum.
+        zero = lax.pcast(jnp.zeros(()), (data_axis,), to="varying")
+        stats0 = jax.tree.map(
+            lambda s: lax.pcast(s, (data_axis,), to="varying"),
+            state.batch_stats,
+        )
+        (grads_acc, new_stats, correct, count, loss_sum, aux_sum), _ = lax.scan(
+            accum,
+            (zero_grads, stats0, zero, zero, zero, zero),
+            micros,
+        )
+        grads = jax.tree.map(lambda g: g / accum_steps, grads_acc)
+        new_stats = jax.tree.map(lambda s: lax.pmean(s, data_axis), new_stats)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+        )
+        metrics = {"loss": lax.pmean(loss_sum / accum_steps, data_axis)}
+        if compute_accuracy:
+            metrics["accuracy"] = lax.psum(correct, data_axis) / jnp.maximum(
+                lax.psum(count, data_axis), 1.0
+            )
+        return new_state, metrics
+
+    sharded = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(P(), P(data_axis)),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
 def make_eval_step(
     model,
     mesh: Mesh,

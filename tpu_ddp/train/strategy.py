@@ -42,7 +42,7 @@ from tpu_ddp.parallel.mesh import (
 from tpu_ddp.train.losses import cross_entropy_loss, masked_accuracy
 from tpu_ddp.train.state import TrainState, create_train_state
 
-PARALLELISMS = ("dp", "fsdp", "tp", "pp", "sp", "ep")
+PARALLELISMS = ("dp", "fsdp", "tp", "fsdp_tp", "pp", "sp", "ep")
 
 # Which mesh axis (other than data) each inferred mode keys on.
 _AXIS_TO_MODE = {
@@ -107,6 +107,7 @@ def default_mesh_sizes(parallelism: str) -> dict:
         "dp": {"data": -1},
         "fsdp": {"data": -1},
         "tp": {"data": -1, "model": 2},
+        "fsdp_tp": {"data": -1, "model": 2},
         "pp": {"data": -1, "pipeline": 2},
         "sp": {"data": -1, "sequence": 2},
         "ep": {"data": -1, "expert": 2},
@@ -332,6 +333,18 @@ def build_strategy(
         state = initial_state or create_train_state(model, tx, rng)
         has_bs = False  # ViT family: no BatchNorm
         step, shardings = make_tp_train_step(
+            model, tx, mesh, state, loss_fn=loss_fn, aux_weight=aux_weight
+        )
+    elif parallelism == "fsdp_tp":
+        # Scaling-book 2-D layout: Megatron TP over `model` + ZeRO-3
+        # scatter over `data` on every big tensor. Explicit mode (--mesh
+        # data=2,model=4 alone infers plain tp; add --parallelism fsdp_tp).
+        _require_model(model, ("vit", "moe"), "fsdp_tp")
+        from tpu_ddp.parallel.tensor_parallel import make_fsdp_tp_train_step
+
+        state = initial_state or create_train_state(model, tx, rng)
+        has_bs = False
+        step, shardings = make_fsdp_tp_train_step(
             model, tx, mesh, state, loss_fn=loss_fn, aux_weight=aux_weight
         )
     elif parallelism == "ep":

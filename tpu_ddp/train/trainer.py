@@ -67,12 +67,20 @@ class TrainConfig:
     steps_per_call: int = 1               # >1: fuse K optimizer steps into
                                           # one dispatch (lax.scan) — hides
                                           # host overhead on small models
+    grad_accum_steps: int = 1             # >1: split each step's shard rows
+                                          # into K sequential microbatches
+                                          # (one optimizer step, ~1/K the
+                                          # activation memory) — big-batch
+                                          # knob the reference lacks
     prefetch_depth: int = 2               # >0: assemble batches ahead on the
                                           # native host prefetcher (C++ ring
                                           # buffer; 0 disables)
     remat: bool = False                   # jax.checkpoint the forward:
                                           # trade FLOPs for HBM on big models
     model: str = "netresdeep"
+    n_chans1: int = 32                    # NetResDeep width (the reference's
+                                          # ctor arg, model/resnet.py:5)
+    n_blocks: int = 10                    # NetResDeep depth (same ctor)
     tied_blocks: bool = True              # the reference's weight-tying quirk
     attention: str = "full"               # full | flash (Pallas kernel,
                                           # ViT-family models; fwd AND bwd
@@ -115,6 +123,8 @@ def build_model(config: TrainConfig):
     name = config.model.lower()
     if name == "netresdeep":
         return NetResDeep(
+            n_chans1=config.n_chans1,
+            n_blocks=config.n_blocks,
             tied=config.tied_blocks,
             num_classes=config.num_classes,
             bn_cross_replica_axis=bn_axis,
@@ -302,18 +312,38 @@ class Trainer:
             self.state = create_train_state(
                 self.model, self.tx, jax.random.key(config.seed)
             )
-        self.train_step = make_train_step(
-            self.model, self.tx, self.mesh,
-            loss_fn=loss_fn, compute_accuracy=with_acc, remat=config.remat,
-            augment=config.augment, augment_seed=config.seed,
-            aux_weight=config.aux_weight,
-        )
+        if config.grad_accum_steps > 1:
+            from tpu_ddp.train.steps import make_grad_accum_train_step
+
+            if config.augment:
+                raise ValueError(
+                    "--augment is not yet supported with --grad-accum-steps"
+                )
+            self.train_step = make_grad_accum_train_step(
+                self.model, self.tx, self.mesh,
+                accum_steps=config.grad_accum_steps,
+                loss_fn=loss_fn, compute_accuracy=with_acc,
+                remat=config.remat, aux_weight=config.aux_weight,
+            )
+        else:
+            self.train_step = make_train_step(
+                self.model, self.tx, self.mesh,
+                loss_fn=loss_fn, compute_accuracy=with_acc, remat=config.remat,
+                augment=config.augment, augment_seed=config.seed,
+                aux_weight=config.aux_weight,
+            )
         self.multi_step = None
         # Clamp to the epoch length: a scan longer than the epoch would
         # compile but never fill, silently running every step un-fused.
         self.steps_per_call = min(
             config.steps_per_call, self.train_loader.steps_per_epoch
         )
+        if self.steps_per_call > 1 and config.grad_accum_steps > 1:
+            raise ValueError(
+                "--steps-per-call and --grad-accum-steps are opposite "
+                "trades (fuse more steps per dispatch vs split one step "
+                "into microbatches); pick one"
+            )
         if self.steps_per_call > 1:
             from tpu_ddp.parallel.mesh import stacked_batch_sharding
             from tpu_ddp.train.steps import make_scan_train_step
@@ -343,6 +373,7 @@ class Trainer:
             (config.augment, "--augment"),
             (config.remat, "--remat"),
             (config.sync_bn, "--sync-bn"),
+            (config.grad_accum_steps > 1, "--grad-accum-steps"),
         ):
             if flag:
                 raise ValueError(
