@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Microbenchmark of the native (C++) data-path components vs their numpy
+fallbacks — the in-tree equivalent of the reference's torch DataLoader
+worker pool + torchvision decode (SURVEY.md §2.6).
+
+Measures, on the host CPU (no accelerator involved — these are host-side
+components by design):
+
+- ``decode_normalize``: planar-RGB uint8 (N, 3072) -> normalized NHWC
+  float32, C++ (native/cifar_codec.cpp, OpenMP) vs the numpy expression it
+  replaces.
+- ``gather_rows``: fancy-index batch assembly, C++ vs ``src[idx]``.
+
+Writes benchmarks/native_cpu.json and prints it. Run:
+
+    python benchmarks/bench_native.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _best_of(fn, repeats=5):
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main() -> None:
+    from tpu_ddp import native
+    from tpu_ddp.data.cifar10 import CIFAR10_MEAN, CIFAR10_STD
+
+    rng = np.random.default_rng(0)
+    out = {"host_cpus": os.cpu_count()}
+
+    # decode_normalize: the full CIFAR-10 train set's worth of rows.
+    raw = rng.integers(0, 256, size=(50_000, 3072), dtype=np.uint8)
+    native_t = _best_of(
+        lambda: native.decode_normalize(raw, CIFAR10_MEAN, CIFAR10_STD)
+    )
+
+    def numpy_decode():
+        x = raw.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        x = x.astype(np.float32) / 255.0
+        return (x - CIFAR10_MEAN) / CIFAR10_STD
+
+    numpy_t = _best_of(numpy_decode)
+    # Parity before speed claims.
+    np.testing.assert_allclose(
+        native.decode_normalize(raw[:256], CIFAR10_MEAN, CIFAR10_STD),
+        numpy_decode()[:256],
+        atol=1e-6,
+    )
+    out["decode_normalize_50k"] = {
+        "native_ms": round(native_t * 1e3, 1),
+        "numpy_ms": round(numpy_t * 1e3, 1),
+        "speedup": round(numpy_t / native_t, 2),
+    }
+
+    # gather_rows: batch assembly of 1024 rows from the decoded set.
+    src = numpy_decode().reshape(50_000, -1)
+    idx = rng.integers(0, len(src), size=1024).astype(np.int64)
+    native_g = _best_of(lambda: native.gather_rows(src, idx), repeats=50)
+    numpy_g = _best_of(lambda: src[idx], repeats=50)
+    np.testing.assert_array_equal(native.gather_rows(src, idx), src[idx])
+    out["gather_rows_1024"] = {
+        "native_us": round(native_g * 1e6, 1),
+        "numpy_us": round(numpy_g * 1e6, 1),
+        "speedup": round(numpy_g / native_g, 2),
+    }
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "native_cpu.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

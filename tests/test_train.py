@@ -197,3 +197,28 @@ def test_grad_accum_cli_and_guards(tmp_path, devices):
             "--epochs", "1", "--batch-size", "8", "--grad-accum-steps", "2",
             "--steps-per-call", "4",
         ])
+
+
+def test_weight_decay_excludes_bias_and_bn(devices):
+    """--weight-decay must decay kernels ONLY: BN scales/offsets and biases
+    are excluded (the standard recipe exclusion; the reference has no wd at
+    all, main.py:27)."""
+    import numpy as np
+
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.train import create_train_state, make_optimizer
+
+    model = NetResDeep(n_chans1=8, n_blocks=1)
+    tx = make_optimizer(lr=1.0, weight_decay=0.1)
+    state = create_train_state(model, tx, jax.random.key(0))
+    import jax.numpy as jnp
+
+    zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+    updates, _ = tx.update(zero_grads, state.opt_state, state.params)
+    flat = jax.tree_util.tree_flatten_with_path(updates)[0]
+    for path, u in flat:
+        name = jax.tree_util.keystr(path)
+        if np.asarray(u).ndim >= 2:
+            assert np.abs(np.asarray(u)).max() > 0, f"kernel {name} not decayed"
+        else:
+            assert np.abs(np.asarray(u)).max() == 0, f"{name} decayed"

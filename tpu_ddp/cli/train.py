@@ -319,6 +319,17 @@ def main(argv=None) -> dict:
         return run_cv(args, config)
     trainer = Trainer(config)
     metrics = trainer.run()
+    if metrics.get("preempted"):
+        # Drained on a preemption signal: the checkpoint is written; every
+        # second of post-run work (eval compile, prediction dumps) eats
+        # into the kill grace window. Exit now — --resume picks up the
+        # exact step.
+        trainer.logger.log_text(
+            "preempted: skipping final eval/prediction outputs "
+            "(resume with --resume)"
+        )
+        metrics.setdefault("test_accuracy", float("nan"))
+        return metrics
     # Final test-set eval — the measurement the reference never takes
     # (SURVEY.md §6: no eval loop exists upstream).
     acc, loss = trainer.evaluate()

@@ -15,6 +15,7 @@ timing, images/sec/chip, JSONL metrics, resume.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Optional
 
@@ -618,6 +619,61 @@ class Trainer:
     def _run_impl(self) -> dict:
         c = self.config
         start = time.time()
+        # Preemption safety (beyond SURVEY §5.3's reference scope, which has
+        # no failure handling at all): SIGTERM/SIGINT set a flag; the loop
+        # drains at the next safe boundary, the tail saves a final
+        # checkpoint, and --resume continues from the exact step. This is
+        # what makes training survive TPU-pod preemptions and Ctrl-C
+        # identically.
+        self._preempted = False
+        import signal
+
+        old_handlers = {}
+
+        def _on_signal(signum, frame):
+            del frame
+            self._preempted = True
+            # Async-signal-safe only: no print()/logging here (a buffered
+            # write interrupted mid-print would raise a reentrancy error);
+            # os.write to stderr is safe. The loop logs properly later.
+            os.write(
+                2,
+                b"\ntpu_ddp: signal received - draining, will checkpoint "
+                b"and exit (send again to force-abort)\n",
+            )
+            # Second signal force-aborts: restore the previous handler so
+            # e.g. a repeated Ctrl-C raises KeyboardInterrupt even while
+            # the main thread is stuck in a long XLA compile.
+            signal.signal(signum, old_handlers.get(signum, signal.SIG_DFL))
+
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                old_handlers[sig] = signal.signal(sig, _on_signal)
+        except ValueError:  # not the main thread (e.g. driven from a test)
+            old_handlers = {}
+        try:
+            return self._run_loop(c, start)
+        finally:
+            for sig, handler in old_handlers.items():
+                signal.signal(sig, handler)
+
+    def _preempt_agreed(self) -> bool:
+        """Cross-host agreement on the preemption flag, evaluated at a
+        boundary every host reaches after the same number of steps (epoch
+        end). Per-host flags can differ (signals land at different times,
+        or only on the host the scheduler chose); breaking out unilaterally
+        would leave the other hosts blocked in the next step's collectives.
+        Single-host: the local flag is the agreement."""
+        if self.process_count == 1:
+            return self._preempted
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([self._preempted], dtype=np.int32)
+        )
+        return bool(np.asarray(flags).max())
+
+    def _run_loop(self, c, start) -> dict:
         # Multi-host: this process only counts its LOCAL rows (the loader
         # yields the local slice), so rate against local chips; the per-chip
         # number — the headline metric — is then correct on any pod size,
@@ -635,6 +691,20 @@ class Trainer:
         # holds one batch of HBM, never donated (only state is).
         mfu_probe = None
         start_epoch = int(self.state.step) // self.train_loader.steps_per_epoch
+        # Mid-epoch resume (a preemption checkpoint lands wherever the
+        # signal did): finish the partial epoch by SKIPPING its
+        # already-trained leading batches — set_epoch's shuffle is
+        # deterministic per (seed, epoch), so the skipped prefix is exactly
+        # what the preempted run consumed. No data is double-counted and
+        # the step counter stays aligned with epoch boundaries. (With
+        # --steps-per-call fusion a group can straddle the boundary; we
+        # undershoot and replay at most K-1 steps.)
+        resume_skip = int(self.state.step) % self.train_loader.steps_per_epoch
+        if resume_skip:
+            self.logger.log_text(
+                f"mid-epoch resume: skipping the first {resume_skip} "
+                f"already-trained steps of epoch {start_epoch + 1}"
+            )
         # Trace the FIRST STEADY-STATE epoch (epoch 2 of the run: epoch 1 is
         # XLA-compile-dominated); a 1-epoch run traces what it has.
         profile_epoch = (
@@ -653,7 +723,21 @@ class Trainer:
             step_losses = []
             epoch_metrics = None
             n_steps = 0
+            skip = resume_skip if epoch == start_epoch + 1 else 0
             for kind, dev_batch, n_real in self._epoch_stream():
+                # Drain at batch boundaries only when single-host: on a pod
+                # the hosts must agree first (epoch boundary, below) or the
+                # others would block in the next step's collectives.
+                if self.process_count == 1 and self._preempted:
+                    break
+                if skip:
+                    item_steps = (
+                        self.steps_per_call if kind == "stacked" else 1
+                    )
+                    if skip >= item_steps:
+                        skip -= item_steps
+                        continue
+                    skip = 0  # straddling fused group: replay its tail
                 if kind == "stacked":
                     self.state, epoch_metrics = self.multi_step(
                         self.state, dev_batch
@@ -693,6 +777,13 @@ class Trainer:
                 if step_losses
                 else float("nan")
             )
+            if self._preempt_agreed():
+                self.logger.log_text(
+                    f"preempted at step {int(self.state.step)} "
+                    f"(epoch {epoch}): saving final checkpoint"
+                )
+                last_metrics["preempted"] = True
+                break  # the tail below writes the final checkpoint
             if epoch > start_epoch + 1:  # device_get above = a sync boundary
                 steady_seconds += time.perf_counter() - epoch_t0
                 steady_steps += n_steps
