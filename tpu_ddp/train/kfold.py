@@ -59,4 +59,9 @@ def run_kfold(
         metrics = trainer.run()
         acc, loss = trainer.evaluate()
         results.append({**metrics, "fold": i, "val_accuracy": acc, "val_loss": loss})
+        if metrics.get("preempted"):
+            # A drained fold means SIGTERM/SIGINT arrived: starting the next
+            # fold would reinstall fresh handlers and burn the kill grace
+            # window training — stop here and let the caller exit cleanly.
+            break
     return results

@@ -778,9 +778,17 @@ class Trainer:
                 else float("nan")
             )
             if self._preempt_agreed():
+                if epoch == profile_epoch:
+                    # the break below would skip the steady-state stop_trace;
+                    # the device_get above already fenced this epoch
+                    jax.profiler.stop_trace()
+                    self.logger.log_text(f"profiler trace -> {c.profile_dir}")
                 self.logger.log_text(
                     f"preempted at step {int(self.state.step)} "
-                    f"(epoch {epoch}): saving final checkpoint"
+                    f"(epoch {epoch}): "
+                    + ("saving final checkpoint"
+                       if self.checkpointer else
+                       "no --checkpoint-dir, progress will NOT survive")
                 )
                 last_metrics["preempted"] = True
                 break  # the tail below writes the final checkpoint
