@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Opportunistic on-chip evidence capture.
+
+The TPU runtime in this environment is intermittently available: the pool
+grants the chip to one client at a time, and an uncleanly-killed client
+wedges backend init for every later process until the pool-side grant times
+out (measured: >30 min).  ``bench.py`` is budgeted for the driver's timeout;
+this tool is the complement for long-running builder sessions — run it
+whenever the chip looks free and it converts the window into committed
+artifacts:
+
+- probes the backend first (cheap child, 75s) and exits 0 doing nothing if
+  the runtime is wedged — it never queues a second client behind a stuck
+  grant;
+- runs each bench leg (``flagship`` / ``baseline`` / ``compute`` /
+  ``attention``) in its OWN subprocess with its own timeout, so one
+  slow-compiling leg cannot take down the others' results, and a leg that
+  wedges is killed without losing what already landed;
+- appends every attempt to ``benchmarks/attempts.jsonl`` (the round's
+  append-only evidence log) and folds completed legs into
+  ``benchmarks/bench_tpu.json``.
+
+Usage: ``python benchmarks/capture_tpu.py [--legs flagship,baseline,...]
+[--leg-timeout 900]``.  Exit 0 always; the artifacts are the output.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ATTEMPTS = os.path.join(_REPO, "benchmarks", "attempts.jsonl")
+_OUT = os.path.join(_REPO, "benchmarks", "bench_tpu.json")
+
+_LEG_CODE = {
+    # Each leg is a self-contained child program printing ONE JSON line.
+    # The persistent compile cache makes retries cheap across processes.
+    "flagship": "import bench; print(__import__('json').dumps("
+                "bench._bench_flagship(False)))",
+    "baseline": "import bench; print(__import__('json').dumps("
+                "bench._bench_dispatch_baseline()))",
+    "compute": "import bench; print(__import__('json').dumps("
+               "bench._bench_compute_bound(False)))",
+    "attention": "import bench; print(__import__('json').dumps("
+                 "bench._bench_attention()))",
+    # Tuning sweep for the flagship: how far does scan-fusion amortize the
+    # per-dispatch cost on the real chip? Reports img/s/chip per
+    # (steps_per_call, per_shard_batch) point; the best point is the
+    # framework's recommended flagship config.
+    "sweep": """
+import json
+import jax, numpy as np
+from tpu_ddp.data import synthetic_cifar10
+from tpu_ddp.models import NetResDeep
+from tpu_ddp.parallel import MeshSpec, create_mesh, stacked_batch_sharding
+from tpu_ddp.train import create_train_state, make_optimizer, make_scan_train_step
+import bench
+
+mesh = create_mesh(MeshSpec(data=-1), jax.devices())
+n = len(jax.devices())
+model, tx = NetResDeep(), make_optimizer(lr=1e-2)
+points = []
+for K in (32, 64, 128):
+    for per_shard in (32, 256):
+        state = create_train_state(model, tx, jax.random.key(0))
+        step = make_scan_train_step(model, tx, mesh, steps_per_call=K)
+        gb = per_shard * n
+        imgs, labels = synthetic_cifar10(K * gb, seed=0)
+        batch = {
+            'image': imgs.astype(np.float32).reshape(K, gb, 32, 32, 3),
+            'label': labels.reshape(K, gb),
+            'mask': np.ones((K, gb), bool),
+        }
+        batch = jax.device_put(batch, stacked_batch_sharding(mesh))
+        _, calls, elapsed = bench._measure(
+            step, state, batch, target_seconds=6.0, max_calls=50)
+        rate = round(calls * K * gb / elapsed / n, 1)
+        points.append({'steps_per_call': K, 'per_shard_batch': per_shard,
+                       'images_per_sec_per_chip': rate})
+        print(json.dumps(points[-1]))
+best = max(points, key=lambda p: p['images_per_sec_per_chip'])
+print(json.dumps({'points': points, 'best': best}))
+""",
+}
+
+_PRELUDE = (
+    "import os, sys, time; sys.path.insert(0, {repo!r}); "
+    "os.environ['BENCH_DEADLINE_TS'] = str(time.time() + 10**6); "
+    "import jax; "
+    "jax.config.update('jax_compilation_cache_dir', "
+    "'/tmp/tpu_ddp_xla_cache'); "
+    "jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0); "
+).format(repo=_REPO)
+
+
+def _append_attempt(rec: dict) -> None:
+    rec = {"ts": round(time.time(), 1), **rec}
+    with open(_ATTEMPTS, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def _probe(timeout: float = 75.0):
+    code = (
+        "import jax, json; d = jax.devices(); "
+        "print(json.dumps({'backend': jax.default_backend(), "
+        "'kind': d[0].device_kind, 'n': len(d)}))"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if p.returncode != 0:
+        return None
+    try:
+        return json.loads(p.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return None
+
+
+def _run_leg(name: str, timeout: float):
+    t0 = time.time()
+    try:
+        p = subprocess.run(
+            [sys.executable, "-u", "-c", _PRELUDE + _LEG_CODE[name]],
+            capture_output=True, text=True, timeout=timeout, cwd=_REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"leg timed out after {timeout:.0f}s", time.time() - t0
+    wall = time.time() - t0
+    if p.returncode != 0:
+        tail = " | ".join((p.stderr or "").strip().splitlines()[-3:])
+        return None, f"rc={p.returncode}: {tail}", wall
+    try:
+        return json.loads(p.stdout.strip().splitlines()[-1]), None, wall
+    except (json.JSONDecodeError, IndexError):
+        return None, "no JSON on stdout", wall
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--legs", default="flagship,baseline,attention,compute",
+                    help="comma-separated subset, run in the given order")
+    ap.add_argument("--leg-timeout", type=float, default=900.0)
+    args = ap.parse_args()
+
+    info = _probe()
+    if info is None or info.get("backend") == "cpu":
+        print("capture_tpu: runtime unavailable (wedged or CPU-only); "
+              "nothing attempted", flush=True)
+        _append_attempt({"stage": "capture_probe", "ok": False})
+        return
+    print(f"capture_tpu: chip up: {info}", flush=True)
+    _append_attempt({"stage": "capture_probe", "ok": True, "info": info})
+
+    try:
+        doc = json.load(open(_OUT))
+    except (OSError, json.JSONDecodeError):
+        doc = {}
+    doc.setdefault("device_kind", info.get("kind"))
+    doc.setdefault("backend", info.get("backend"))
+
+    for leg in [x.strip() for x in args.legs.split(",") if x.strip()]:
+        if leg not in _LEG_CODE:
+            print(f"capture_tpu: unknown leg {leg!r}, skipping", flush=True)
+            continue
+        print(f"capture_tpu: leg {leg} starting", flush=True)
+        result, err, wall = _run_leg(leg, args.leg_timeout)
+        _append_attempt({
+            "stage": f"capture_{leg}", "wall_s": round(wall, 1),
+            "error": err, "result": result,
+        })
+        if result is not None:
+            doc[leg] = {"captured_unix_ts": round(time.time(), 1),
+                        "wall_s": round(wall, 1), **result}
+            json.dump(doc, open(_OUT, "w"), indent=1)
+        print(f"capture_tpu: leg {leg} -> "
+              f"{'ok' if result else err} [{wall:.0f}s]", flush=True)
+        if err and "timed out" in err:
+            # A killed client may have wedged the grant: later legs would
+            # queue behind it and burn their whole timeout. Stop; rerun
+            # when the runtime recovers.
+            print("capture_tpu: stopping after timeout (grant may be "
+                  "wedged)", flush=True)
+            break
+    print(f"capture_tpu: done; artifacts in {_OUT}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

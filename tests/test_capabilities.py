@@ -105,6 +105,34 @@ def test_kfold_split_properties():
         kfold_split(10, 1)
 
 
+def test_kfold_stops_after_preempted_fold():
+    """A fold that drained on SIGTERM/SIGINT must be the LAST fold: training
+    the next one would burn the preemption grace window (run_kfold's break)."""
+    from tpu_ddp.train.kfold import run_kfold
+
+    ran = []
+
+    class _FakeTrainer:
+        def __init__(self, fold):
+            self.fold = fold
+
+        def run(self):
+            ran.append(self.fold)
+            return {"preempted": True} if self.fold == 1 else {}
+
+        def evaluate(self):
+            return 0.5, 1.0
+
+    results = run_kfold(
+        np.zeros((20, 32, 32, 3), np.float32),
+        np.zeros(20, np.int32),
+        k=4,
+        make_trainer=lambda train, val, i: _FakeTrainer(i),
+    )
+    assert ran == [0, 1]  # folds 2..3 never started
+    assert len(results) == 2 and results[-1]["preempted"]
+
+
 def test_average_precision_known_values():
     scores = np.array([0.9, 0.8, 0.7, 0.6])
     targets = np.array([1, 0, 1, 0])

@@ -295,16 +295,27 @@ def run_cv(args, config) -> dict:
         np.asarray(images), np.asarray(labels),
         k=args.cv_mode, make_trainer=make_trainer, seed=config.seed,
     )
-    accs = [r["val_accuracy"] for r in results]
-    print(
-        f"[cv] val accuracy per fold: "
-        + ", ".join(f"{a:.4f}" for a in accs)
-        + f" | mean {np.mean(accs):.4f} +- {np.std(accs):.4f}"
-    )
+    preempted = any(r.get("preempted") for r in results)
+    # a drained (preempted) fold carries no val metrics and is excluded from
+    # the aggregate — a half-trained fold would depress the mean
+    accs = [r["val_accuracy"] for r in results if "val_accuracy" in r]
+    if preempted:
+        print(
+            f"[cv] preempted after {len(accs)}/{args.cv_mode} completed "
+            "folds; aggregate covers completed folds only"
+        )
+    if accs:
+        print(
+            f"[cv] val accuracy per fold: "
+            + ", ".join(f"{a:.4f}" for a in accs)
+            + f" | mean {np.mean(accs):.4f} +- {np.std(accs):.4f}"
+        )
     return {
         "cv_results": results,
-        "mean_val_accuracy": float(np.mean(accs)),
-        "std_val_accuracy": float(np.std(accs)),
+        "preempted": preempted,
+        "completed_folds": len(accs),
+        "mean_val_accuracy": float(np.mean(accs)) if accs else None,
+        "std_val_accuracy": float(np.std(accs)) if accs else None,
     }
 
 

@@ -57,11 +57,14 @@ def run_kfold(
             i,
         )
         metrics = trainer.run()
+        if metrics.get("preempted"):
+            # A drained fold means SIGTERM/SIGINT arrived: evaluating the
+            # half-trained fold or starting the next one would burn the kill
+            # grace window — record the drain and let the caller exit
+            # cleanly. The partial fold carries no val metrics so it can
+            # never be aggregated as a completed fold.
+            results.append({**metrics, "fold": i})
+            break
         acc, loss = trainer.evaluate()
         results.append({**metrics, "fold": i, "val_accuracy": acc, "val_loss": loss})
-        if metrics.get("preempted"):
-            # A drained fold means SIGTERM/SIGINT arrived: starting the next
-            # fold would reinstall fresh handlers and burn the kill grace
-            # window training — stop here and let the caller exit cleanly.
-            break
     return results

@@ -777,12 +777,13 @@ class Trainer:
                 if step_losses
                 else float("nan")
             )
+            if epoch == profile_epoch:
+                # the device_get above already fenced the epoch's dispatches;
+                # stopping here (before the preempt check) covers both the
+                # normal path and a drain during the profiled epoch
+                jax.profiler.stop_trace()
+                self.logger.log_text(f"profiler trace -> {c.profile_dir}")
             if self._preempt_agreed():
-                if epoch == profile_epoch:
-                    # the break below would skip the steady-state stop_trace;
-                    # the device_get above already fenced this epoch
-                    jax.profiler.stop_trace()
-                    self.logger.log_text(f"profiler trace -> {c.profile_dir}")
                 self.logger.log_text(
                     f"preempted at step {int(self.state.step)} "
                     f"(epoch {epoch}): "
@@ -795,10 +796,6 @@ class Trainer:
             if epoch > start_epoch + 1:  # device_get above = a sync boundary
                 steady_seconds += time.perf_counter() - epoch_t0
                 steady_steps += n_steps
-            if epoch == profile_epoch:
-                # the device_get above already fenced the epoch's dispatches
-                jax.profiler.stop_trace()
-                self.logger.log_text(f"profiler trace -> {c.profile_dir}")
             self.history["epoch"].append(epoch)
             self.history["train_loss"].append(mean_loss)
             if epoch == 1 or epoch % c.log_every_epochs == 0:
