@@ -71,6 +71,7 @@ _RESULTS_ENV = "BENCH_RESULTS_PATH"
 _DEADLINE_ENV = "BENCH_DEADLINE_TS"
 
 _START = time.time()
+_ACTIVE_CHILD = None  # the currently-running bench child (see _on_term)
 
 
 def _remaining() -> float:
@@ -106,6 +107,21 @@ def _emit(result: dict) -> None:
 
 def _child_deadline() -> float:
     return float(os.environ.get(_DEADLINE_ENV, time.time() + 300))
+
+
+def _terminate_gracefully(proc, grace: float = 15.0) -> None:
+    """TERM, wait ``grace``, then KILL. A SIGKILLed child that holds the TPU
+    pool grant wedges backend init for EVERY later client until the
+    pool-side grant times out (measured this round: >50 min); a TERM'd child
+    between dispatches tears down its PJRT client and releases the grant."""
+    if proc.poll() is not None:
+        return
+    proc.terminate()
+    try:
+        proc.wait(timeout=grace)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
 
 
 # ----------------------------------------------------------------- child --
@@ -470,18 +486,25 @@ def _probe_backend(env) -> tuple:
         "'n': len(jax.devices()), "
         "'kind': jax.devices()[0].device_kind}))"
     )
+    global _ACTIVE_CHILD
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    _ACTIVE_CHILD = proc  # _on_term must reap a mid-probe TPU client too
     try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            env=env, capture_output=True, text=True, timeout=timeout,
-        )
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        _terminate_gracefully(proc)
+        stdout, stderr = proc.communicate()
         return False, f"backend probe timed out after {timeout:.0f}s"
+    finally:
+        _ACTIVE_CHILD = None
     if proc.returncode != 0:
-        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        tail = (stderr or "").strip().splitlines()[-3:]
         return False, "probe failed: " + " | ".join(tail)
     try:
-        return True, json.loads(proc.stdout.strip().splitlines()[-1])
+        return True, json.loads(stdout.strip().splitlines()[-1])
     except (json.JSONDecodeError, IndexError):
         return False, "probe printed no JSON"
 
@@ -498,12 +521,18 @@ def _run_child(env, quick: bool, results_path: str, timeout_s: float):
     env[_DEADLINE_ENV] = str(time.time() + timeout_s)
     env["PYTHONUNBUFFERED"] = "1"
     err = None
+    global _ACTIVE_CHILD
+    proc = subprocess.Popen(cmd, env=env, cwd=_REPO)
+    _ACTIVE_CHILD = proc
     try:
-        proc = subprocess.run(cmd, env=env, cwd=_REPO, timeout=timeout_s + 30)
-        if proc.returncode != 0:
-            err = f"child rc={proc.returncode}"
+        rc = proc.wait(timeout=timeout_s + 30)
+        if rc != 0:
+            err = f"child rc={rc}"
     except subprocess.TimeoutExpired:
         err = f"child timed out after {timeout_s:.0f}s"
+        _terminate_gracefully(proc, grace=20)
+    finally:
+        _ACTIVE_CHILD = None
     last = None
     try:
         with open(results_path) as f:
@@ -520,6 +549,20 @@ def main() -> None:
     if "--child" in sys.argv:
         child_main(quick="--quick" in sys.argv)
         return
+
+    import signal
+
+    def _on_term(signum, frame):
+        # The driver TERMs this parent at ITS timeout (rc=124). Dying
+        # without tearing down the bench child would orphan a grant-holding
+        # TPU client — the wedge that poisoned rounds 1-2. Forward the TERM
+        # and give the child a moment to release the grant.
+        child = _ACTIVE_CHILD
+        if child is not None:
+            _terminate_gracefully(child)
+        raise SystemExit(124)
+
+    signal.signal(signal.SIGTERM, _on_term)
 
     import tempfile
 

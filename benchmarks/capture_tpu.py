@@ -98,50 +98,54 @@ _PRELUDE = (
 ).format(repo=_REPO)
 
 
+sys.path.insert(0, _REPO)
+import bench  # noqa: E402  (stdlib-only at module level; never imports jax)
+
+
 def _append_attempt(rec: dict) -> None:
     rec = {"ts": round(time.time(), 1), **rec}
-    with open(_ATTEMPTS, "a") as f:
-        f.write(json.dumps(rec) + "\n")
+    try:
+        with open(_ATTEMPTS, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass  # bookkeeping must never break the capture (bench.py rule)
 
 
-def _probe(timeout: float = 75.0):
-    code = (
-        "import jax, json; d = jax.devices(); "
-        "print(json.dumps({'backend': jax.default_backend(), "
-        "'kind': d[0].device_kind, 'n': len(d)}))"
-    )
-    try:
-        p = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=timeout,
-        )
-    except subprocess.TimeoutExpired:
-        return None
-    if p.returncode != 0:
-        return None
-    try:
-        return json.loads(p.stdout.strip().splitlines()[-1])
-    except (json.JSONDecodeError, IndexError):
-        return None
+def _probe():
+    # bench._probe_backend owns the grant-safe TERM-then-KILL protocol; one
+    # implementation, two callers.
+    ok, info = bench._probe_backend(dict(os.environ))
+    return info if ok else None
 
 
 def _run_leg(name: str, timeout: float):
     t0 = time.time()
+    p = subprocess.Popen(
+        [sys.executable, "-u", "-c", _PRELUDE + _LEG_CODE[name]],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=_REPO,
+    )
     try:
-        p = subprocess.run(
-            [sys.executable, "-u", "-c", _PRELUDE + _LEG_CODE[name]],
-            capture_output=True, text=True, timeout=timeout, cwd=_REPO,
-        )
+        out, errout = p.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        bench._terminate_gracefully(p, grace=20)
+        p.communicate()
         return None, f"leg timed out after {timeout:.0f}s", time.time() - t0
     wall = time.time() - t0
     if p.returncode != 0:
-        tail = " | ".join((p.stderr or "").strip().splitlines()[-3:])
+        tail = " | ".join((errout or "").strip().splitlines()[-3:])
         return None, f"rc={p.returncode}: {tail}", wall
     try:
-        return json.loads(p.stdout.strip().splitlines()[-1]), None, wall
+        return json.loads(out.strip().splitlines()[-1]), None, wall
     except (json.JSONDecodeError, IndexError):
         return None, "no JSON on stdout", wall
+
+
+def _write_doc(doc: dict) -> None:
+    # atomic: a kill mid-write must not corrupt previously captured evidence
+    tmp = _OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, _OUT)
 
 
 def main() -> None:
@@ -180,7 +184,7 @@ def main() -> None:
         if result is not None:
             doc[leg] = {"captured_unix_ts": round(time.time(), 1),
                         "wall_s": round(wall, 1), **result}
-            json.dump(doc, open(_OUT, "w"), indent=1)
+            _write_doc(doc)
         print(f"capture_tpu: leg {leg} -> "
               f"{'ok' if result else err} [{wall:.0f}s]", flush=True)
         if err and "timed out" in err:
