@@ -475,11 +475,14 @@ def _scrubbed_cpu_env() -> dict:
     return env
 
 
-def _probe_backend(env) -> tuple:
+def _probe_backend(env, timeout=None) -> tuple:
     """(ok, info_or_error): can a child process see devices at all, within
-    _PROBE_TIMEOUT_S? Keeps the bench child from burning its budget against
-    a hung TPU runtime (rounds 1-2 failure mode)."""
-    timeout = max(5.0, min(_PROBE_TIMEOUT_S, _remaining() - 30))
+    _PROBE_TIMEOUT_S (or an explicit ``timeout`` decoupled from this
+    module's driver-budget accounting, for external callers)? Keeps the
+    bench child from burning its budget against a hung TPU runtime
+    (rounds 1-2 failure mode)."""
+    if timeout is None:
+        timeout = max(5.0, min(_PROBE_TIMEOUT_S, _remaining() - 30))
     code = (
         "import jax, json; "
         "print(json.dumps({'backend': jax.default_backend(), "
@@ -573,6 +576,13 @@ def main() -> None:
 
     ok, info = _probe_backend(dict(os.environ))
     _record_attempt("probe", ok=ok, info=info)
+    if ok and isinstance(info, dict) and info.get("backend") == "cpu":
+        # The runtime fell back to the CPU backend (wedged TPU with a
+        # cpu-permitting platform config): the full non-quick bench is
+        # doomed there (K=32 flagship + measured baseline ran >60s and
+        # timed out when this happened) — go straight to the quick path.
+        ok = False
+        info = f"probe landed on cpu backend: {info}"
     if ok:
         timeout_s = max(60.0, _remaining() - 120)
         result, err = _run_child(
@@ -609,6 +619,17 @@ def main() -> None:
         )
         if result is not None:
             result["backend_error"] = "; ".join(errors)
+            # Context for a wedged-runtime round: attach the last COMMITTED
+            # on-chip capture (benchmarks/bench_tpu.json, written by a
+            # successful bench/capture run), clearly labeled as prior
+            # evidence with its own timestamp — not as this run's number.
+            try:
+                with open(
+                    os.path.join(_REPO, "benchmarks", "bench_tpu.json")
+                ) as f:
+                    result["last_recorded_tpu"] = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                pass
             print(json.dumps(result), flush=True)
             return
         errors.append(f"cpu fallback: {err}")

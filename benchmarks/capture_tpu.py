@@ -29,12 +29,12 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_ATTEMPTS = os.path.join(_REPO, "benchmarks", "attempts.jsonl")
 _OUT = os.path.join(_REPO, "benchmarks", "bench_tpu.json")
 
 _LEG_CODE = {
@@ -65,7 +65,7 @@ mesh = create_mesh(MeshSpec(data=-1), jax.devices())
 n = len(jax.devices())
 model, tx = NetResDeep(), make_optimizer(lr=1e-2)
 points = []
-for K in (32, 64, 128):
+for K in (32, 128):
     for per_shard in (32, 256):
         state = create_train_state(model, tx, jax.random.key(0))
         step = make_scan_train_step(model, tx, mesh, steps_per_call=K)
@@ -101,35 +101,47 @@ _PRELUDE = (
 sys.path.insert(0, _REPO)
 import bench  # noqa: E402  (stdlib-only at module level; never imports jax)
 
+# bench owns the grant-safe protocol AND the attempts bookkeeping; one
+# implementation, two callers (bench._record_attempt also handles a missing
+# benchmarks/ dir and never raises).
+_record = bench._record_attempt
 
-def _append_attempt(rec: dict) -> None:
-    rec = {"ts": round(time.time(), 1), **rec}
-    try:
-        with open(_ATTEMPTS, "a") as f:
-            f.write(json.dumps(rec) + "\n")
-    except OSError:
-        pass  # bookkeeping must never break the capture (bench.py rule)
+_ACTIVE_LEG = None  # the currently-running leg child (for _on_term)
 
 
-def _probe():
-    # bench._probe_backend owns the grant-safe TERM-then-KILL protocol; one
-    # implementation, two callers.
-    ok, info = bench._probe_backend(dict(os.environ))
+def _on_term(signum, frame):
+    # Being TERM'd while a leg child holds the TPU pool grant must not
+    # orphan it (a SIGKILLed/orphaned grant-holder wedges every later
+    # client; see bench._terminate_gracefully).
+    child = _ACTIVE_LEG
+    if child is not None:
+        bench._terminate_gracefully(child, grace=20)
+    raise SystemExit(124)
+
+
+def _probe(timeout: float = 75.0):
+    # Explicit timeout: bench's internal probe window is tied to ITS
+    # driver-budget accounting; this long-session tool affords a wider one.
+    ok, info = bench._probe_backend(dict(os.environ), timeout=timeout)
     return info if ok else None
 
 
 def _run_leg(name: str, timeout: float):
+    global _ACTIVE_LEG
     t0 = time.time()
     p = subprocess.Popen(
         [sys.executable, "-u", "-c", _PRELUDE + _LEG_CODE[name]],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=_REPO,
     )
+    _ACTIVE_LEG = p
     try:
         out, errout = p.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
         bench._terminate_gracefully(p, grace=20)
         p.communicate()
         return None, f"leg timed out after {timeout:.0f}s", time.time() - t0
+    finally:
+        _ACTIVE_LEG = None
     wall = time.time() - t0
     if p.returncode != 0:
         tail = " | ".join((errout or "").strip().splitlines()[-3:])
@@ -154,15 +166,16 @@ def main() -> None:
                     help="comma-separated subset, run in the given order")
     ap.add_argument("--leg-timeout", type=float, default=900.0)
     args = ap.parse_args()
+    signal.signal(signal.SIGTERM, _on_term)
 
     info = _probe()
     if info is None or info.get("backend") == "cpu":
         print("capture_tpu: runtime unavailable (wedged or CPU-only); "
               "nothing attempted", flush=True)
-        _append_attempt({"stage": "capture_probe", "ok": False})
+        _record("capture_probe", ok=False)
         return
     print(f"capture_tpu: chip up: {info}", flush=True)
-    _append_attempt({"stage": "capture_probe", "ok": True, "info": info})
+    _record("capture_probe", ok=True, info=info)
 
     try:
         doc = json.load(open(_OUT))
@@ -177,10 +190,8 @@ def main() -> None:
             continue
         print(f"capture_tpu: leg {leg} starting", flush=True)
         result, err, wall = _run_leg(leg, args.leg_timeout)
-        _append_attempt({
-            "stage": f"capture_{leg}", "wall_s": round(wall, 1),
-            "error": err, "result": result,
-        })
+        _record(f"capture_{leg}", wall_s=round(wall, 1),
+                error=err, result=result)
         if result is not None:
             doc[leg] = {"captured_unix_ts": round(time.time(), 1),
                         "wall_s": round(wall, 1), **result}

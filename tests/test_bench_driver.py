@@ -1,0 +1,71 @@
+"""Driver-artifact machinery: the grant-safe kill protocol and bench.py's
+"always prints one JSON line, exit 0" contract (rounds 1-2 lost their BENCH
+artifact to exactly these failure modes; see bench.py's module docstring)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import bench  # noqa: E402  (stdlib-only at module level)
+
+
+def _scrubbed_env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU pool here
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_terminate_gracefully_prefers_term():
+    # A cooperative child dies on TERM and is never KILLed.
+    p = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+    t0 = time.time()
+    bench._terminate_gracefully(p, grace=10)
+    assert p.poll() == -signal.SIGTERM
+    assert time.time() - t0 < 5  # did not sit out the grace window
+
+
+def test_terminate_gracefully_kills_term_ignorer():
+    # A child stuck ignoring TERM (stand-in for "blocked in a C++ call")
+    # eats the KILL after the grace window.
+    p = subprocess.Popen([
+        sys.executable, "-c",
+        "import signal, time; signal.signal(signal.SIGTERM, "
+        "signal.SIG_IGN); time.sleep(60)",
+    ])
+    time.sleep(0.5)  # let the child install its handler
+    bench._terminate_gracefully(p, grace=1)
+    assert p.poll() == -signal.SIGKILL
+
+
+def test_bench_always_prints_one_json_line():
+    # Even with a budget too small to run anything, bench.py must exit 0
+    # with a parseable JSON line (the driver artifact contract).
+    env = _scrubbed_env()
+    env["BENCH_TOTAL_BUDGET_S"] = "20"
+    p = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert p.returncode == 0
+    lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
+    assert lines, f"no stdout; stderr tail: {p.stderr[-400:]}"
+    rec = json.loads(lines[-1])
+    assert rec["metric"] == "cifar10_train_images_per_sec_per_chip"
+    assert "value" in rec and "unit" in rec and "vs_baseline" in rec
+
+
+def test_committed_tpu_evidence_is_valid_json():
+    path = os.path.join(_REPO, "benchmarks", "bench_tpu.json")
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["device_kind"].lower().startswith("tpu")
+    flag = doc["flagship"]
+    assert flag["images_per_sec_per_chip"] > 0
+    assert flag["mfu"] is None or flag["mfu"] > 0
