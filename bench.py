@@ -66,7 +66,10 @@ FALLBACK_BASELINE_IMAGES_PER_SEC_PER_CHIP = 16892.0
 TOTAL_BUDGET_S = float(os.environ.get("BENCH_TOTAL_BUDGET_S", 540))
 _PROBE_TIMEOUT_S = 60
 _REPO = os.path.dirname(os.path.abspath(__file__))
-_ATTEMPTS_PATH = os.path.join(_REPO, "benchmarks", "attempts.jsonl")
+# Overridable so tests don't pollute the committed round-evidence log.
+_ATTEMPTS_PATH = os.environ.get(
+    "BENCH_ATTEMPTS_PATH", os.path.join(_REPO, "benchmarks", "attempts.jsonl")
+)
 _RESULTS_ENV = "BENCH_RESULTS_PATH"
 _DEADLINE_ENV = "BENCH_DEADLINE_TS"
 

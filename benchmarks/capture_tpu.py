@@ -113,7 +113,7 @@ def _on_term(signum, frame):
     # Being TERM'd while a leg child holds the TPU pool grant must not
     # orphan it (a SIGKILLed/orphaned grant-holder wedges every later
     # client; see bench._terminate_gracefully).
-    child = _ACTIVE_LEG
+    child = _ACTIVE_LEG or bench._ACTIVE_CHILD  # leg, or a mid-probe client
     if child is not None:
         bench._terminate_gracefully(child, grace=20)
     raise SystemExit(124)

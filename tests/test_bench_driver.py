@@ -33,22 +33,25 @@ def test_terminate_gracefully_prefers_term():
 
 def test_terminate_gracefully_kills_term_ignorer():
     # A child stuck ignoring TERM (stand-in for "blocked in a C++ call")
-    # eats the KILL after the grace window.
+    # eats the KILL after the grace window. Handshake on a sentinel line so
+    # the TERM cannot race the handler installation.
     p = subprocess.Popen([
-        sys.executable, "-c",
+        sys.executable, "-u", "-c",
         "import signal, time; signal.signal(signal.SIGTERM, "
-        "signal.SIG_IGN); time.sleep(60)",
-    ])
-    time.sleep(0.5)  # let the child install its handler
+        "signal.SIG_IGN); print('ready', flush=True); time.sleep(60)",
+    ], stdout=subprocess.PIPE, text=True)
+    assert p.stdout.readline().strip() == "ready"
     bench._terminate_gracefully(p, grace=1)
     assert p.poll() == -signal.SIGKILL
 
 
-def test_bench_always_prints_one_json_line():
+def test_bench_always_prints_one_json_line(tmp_path):
     # Even with a budget too small to run anything, bench.py must exit 0
     # with a parseable JSON line (the driver artifact contract).
     env = _scrubbed_env()
     env["BENCH_TOTAL_BUDGET_S"] = "20"
+    # keep test-noise out of the committed round-evidence log
+    env["BENCH_ATTEMPTS_PATH"] = str(tmp_path / "attempts.jsonl")
     p = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
         env=env, capture_output=True, text=True, timeout=120,

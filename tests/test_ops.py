@@ -137,3 +137,25 @@ def test_interpret_gate_uses_device_kind(monkeypatch):
 
     # Explicit argument always wins.
     assert fa._resolve_interpret(True) is True
+
+
+def test_flash_attention_lowers_to_mosaic_for_tpu():
+    """Deviceless TPU lowering: the compiled (interpret=False) kernels must
+    lower to Mosaic (`tpu_custom_call`) on a CPU-only host. This validates
+    block specs, memory spaces, and kernel structure for the real chip
+    without needing one — the strongest pre-chip guarantee available (the
+    on-chip numerics check lives in bench.py::_bench_attention)."""
+    q, k, v = _qkv()
+
+    fwd = lambda a, b, c: flash_attention(a, b, c, 128, 128, False)
+    text = jax.jit(fwd).trace(q, k, v).lower(
+        lowering_platforms=("tpu",)
+    ).as_text()
+    assert "tpu_custom_call" in text  # Mosaic kernel, not interpreter HLO
+
+    grad = jax.grad(lambda a, b, c: fwd(a, b, c).sum(), (0, 1, 2))
+    text_bwd = jax.jit(grad).trace(q, k, v).lower(
+        lowering_platforms=("tpu",)
+    ).as_text()
+    # backward = fwd-recompute + dQ kernel + dK/dV kernel
+    assert text_bwd.count("tpu_custom_call") == 3
