@@ -151,14 +151,16 @@ def test_flash_attention_lowers_to_mosaic_for_tpu():
     text = jax.jit(fwd).trace(q, k, v).lower(
         lowering_platforms=("tpu",)
     ).as_text()
-    assert "tpu_custom_call" in text  # Mosaic kernel, not interpreter HLO
+    # exact op-syntax count: metadata mentions of the target can't match
+    assert text.count("stablehlo.custom_call @tpu_custom_call") == 1
 
     grad = jax.grad(lambda a, b, c: fwd(a, b, c).sum(), (0, 1, 2))
     text_bwd = jax.jit(grad).trace(q, k, v).lower(
         lowering_platforms=("tpu",)
     ).as_text()
-    # backward = fwd-recompute + dQ kernel + dK/dV kernel
-    assert text_bwd.count("tpu_custom_call") >= 3
+    # backward = fwd-recompute + dQ kernel + dK/dV kernel, exactly — a
+    # duplicated kernel lowering (recompute-cost regression) fails here
+    assert text_bwd.count("stablehlo.custom_call @tpu_custom_call") == 3
 
 
 def test_flash_kernel_runs_inside_gspmd_train_step(devices, monkeypatch):

@@ -222,3 +222,40 @@ def test_weight_decay_excludes_bias_and_bn(devices):
             assert np.abs(np.asarray(u)).max() > 0, f"kernel {name} not decayed"
         else:
             assert np.abs(np.asarray(u)).max() == 0, f"{name} decayed"
+
+
+def test_grad_clip_norm_scales_update(devices):
+    """--grad-clip-norm clips the GLOBAL gradient norm before the update,
+    and sees the RAW gradient: weight decay is added inside (after) the
+    clip, so with decay on, the update's norm exceeds the clip cap."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.train import create_train_state, make_optimizer
+
+    model = NetResDeep(n_chans1=8, n_blocks=1)
+    tx = make_optimizer(lr=1.0, grad_clip_norm=1.0)
+    state = create_train_state(model, tx, jax.random.key(0))
+
+    big_grads = jax.tree.map(lambda p: jnp.full_like(p, 100.0), state.params)
+    updates, _ = tx.update(big_grads, state.opt_state, state.params)
+    gnorm = float(optax.global_norm(updates))
+    np.testing.assert_allclose(gnorm, 1.0, rtol=1e-5)  # clipped to the cap
+
+    small_grads = jax.tree.map(lambda p: jnp.full_like(p, 1e-4), state.params)
+    tx2 = make_optimizer(lr=1.0, grad_clip_norm=1.0)
+    state2 = create_train_state(model, tx2, jax.random.key(0))
+    updates2, _ = tx2.update(small_grads, state2.opt_state, state2.params)
+    # under the cap: untouched (sgd lr=1.0 negates only)
+    for a, b in zip(jax.tree.leaves(updates2), jax.tree.leaves(small_grads)):
+        np.testing.assert_allclose(np.asarray(a), -np.asarray(b), rtol=1e-6)
+
+    # ordering pin: with weight decay ON, the decay term is added AFTER the
+    # clip, so the final update norm exceeds the cap (a flipped chain that
+    # clips the decayed gradient would land at exactly 1.0 and fail here)
+    tx3 = make_optimizer(lr=1.0, grad_clip_norm=1.0, weight_decay=0.1)
+    state3 = create_train_state(model, tx3, jax.random.key(0))
+    updates3, _ = tx3.update(big_grads, state3.opt_state, state3.params)
+    assert float(optax.global_norm(updates3)) > 1.001

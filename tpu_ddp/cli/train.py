@@ -37,6 +37,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--weight-decay", type=float, default=0.0)
     p.add_argument("--schedule", choices=["constant", "cosine"], default="constant")
     p.add_argument("--warmup-steps", type=int, default=0)
+    p.add_argument("--grad-clip-norm", type=float, default=0.0,
+                   help="clip the global gradient norm before the update "
+                        "(0 = off); on DP the clip sees the synchronized "
+                        "gradient, so replicas clip identically")
     p.add_argument("--n-devices", type=int, default=None,
                    help="1 == the main_no_ddp.py single-device baseline")
     p.add_argument("--parallelism",
@@ -225,6 +229,7 @@ def config_from_args(args) -> TrainConfig:
         weight_decay=args.weight_decay,
         schedule=None if args.schedule == "constant" else args.schedule,
         warmup_steps=args.warmup_steps,
+        grad_clip_norm=args.grad_clip_norm,
         n_devices=n_devices,
         parallelism=args.parallelism,
         mesh=mesh_sizes,

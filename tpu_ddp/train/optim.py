@@ -22,9 +22,13 @@ def make_optimizer(
     schedule: Optional[str] = None,
     total_steps: Optional[int] = None,
     warmup_steps: int = 0,
+    grad_clip_norm: float = 0.0,
     freeze_predicate: Optional[Callable[[tuple, object], bool]] = None,
 ) -> optax.GradientTransformation:
-    """freeze_predicate(path_tuple, leaf) -> True to FREEZE that param."""
+    """freeze_predicate(path_tuple, leaf) -> True to FREEZE that param.
+    ``grad_clip_norm`` > 0 clips the GLOBAL gradient norm before the update
+    — on the DP step the clip sees the pmean'd (already-synchronized)
+    gradient, so every replica clips identically."""
     if schedule == "cosine":
         assert total_steps, "cosine schedule needs total_steps"
         lr_sched = optax.warmup_cosine_decay_schedule(
@@ -51,6 +55,10 @@ def make_optimizer(
             optax.masked(optax.add_decayed_weights(weight_decay), _decay_mask),
             tx,
         )
+    if grad_clip_norm > 0:
+        # Outermost: the clip sees the RAW (synchronized) gradient, before
+        # the decoupled weight-decay term is added.
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
 
     if freeze_predicate is not None:
         import jax

@@ -51,6 +51,7 @@ class TrainConfig:
     weight_decay: float = 0.0
     schedule: Optional[str] = None        # "cosine" | None
     warmup_steps: int = 0
+    grad_clip_norm: float = 0.0           # 0 = off (global-norm clip)
     n_devices: Optional[int] = None       # None = all; 1 = main_no_ddp mode
     parallelism: Optional[str] = None     # dp|fsdp|tp|pp|sp|ep; None = infer
                                           # from mesh (default dp)
@@ -238,6 +239,7 @@ class Trainer:
             schedule=config.schedule,
             total_steps=total_steps,
             warmup_steps=config.warmup_steps,
+            grad_clip_norm=config.grad_clip_norm,
             freeze_predicate=freeze,
         )
         from tpu_ddp.train.losses import (
