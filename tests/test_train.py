@@ -226,8 +226,9 @@ def test_weight_decay_excludes_bias_and_bn(devices):
 
 def test_grad_clip_norm_scales_update(devices):
     """--grad-clip-norm clips the GLOBAL gradient norm before the update,
-    and sees the RAW gradient: weight decay is added inside (after) the
-    clip, so with decay on, the update's norm exceeds the clip cap."""
+    and sees the RAW gradient: the (coupled, pre-lr) weight-decay term is
+    added inside (after) the clip, so with decay on, the update's norm
+    exceeds the clip cap."""
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -245,9 +246,8 @@ def test_grad_clip_norm_scales_update(devices):
     np.testing.assert_allclose(gnorm, 1.0, rtol=1e-5)  # clipped to the cap
 
     small_grads = jax.tree.map(lambda p: jnp.full_like(p, 1e-4), state.params)
-    tx2 = make_optimizer(lr=1.0, grad_clip_norm=1.0)
-    state2 = create_train_state(model, tx2, jax.random.key(0))
-    updates2, _ = tx2.update(small_grads, state2.opt_state, state2.params)
+    # optax transforms are pure: reuse the same tx/state
+    updates2, _ = tx.update(small_grads, state.opt_state, state.params)
     # under the cap: untouched (sgd lr=1.0 negates only)
     for a, b in zip(jax.tree.leaves(updates2), jax.tree.leaves(small_grads)):
         np.testing.assert_allclose(np.asarray(a), -np.asarray(b), rtol=1e-6)

@@ -29,6 +29,8 @@ def make_optimizer(
     ``grad_clip_norm`` > 0 clips the GLOBAL gradient norm before the update
     — on the DP step the clip sees the pmean'd (already-synchronized)
     gradient, so every replica clips identically."""
+    if grad_clip_norm < 0:
+        raise ValueError(f"grad_clip_norm must be >= 0, got {grad_clip_norm}")
     if schedule == "cosine":
         assert total_steps, "cosine schedule needs total_steps"
         lr_sched = optax.warmup_cosine_decay_schedule(
@@ -56,8 +58,9 @@ def make_optimizer(
             tx,
         )
     if grad_clip_norm > 0:
-        # Outermost: the clip sees the RAW (synchronized) gradient, before
-        # the decoupled weight-decay term is added.
+        # Outermost: the clip sees the RAW (synchronized) gradient; the
+        # weight-decay term (coupled: added pre-lr, so effective decay is
+        # lr*wd) is applied inside the clip, not subject to it.
         tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
 
     if freeze_predicate is not None:
