@@ -1,0 +1,82 @@
+"""Worker for the 2-process preemption-drain test (launched by
+``test_multihost.py``, not collected by pytest).
+
+The scenario the single-process tests cannot express: the preemption signal
+lands on ONE host only (the scheduler picks a host, SURVEY.md §5.3 scope),
+and the OTHER host must still drain — unilaterally breaking out of the
+epoch loop would leave the signaled host's collectives blocked forever.
+``Trainer._preempt_agreed`` makes hosts agree via a ``process_allgather``
+of the local flag at the epoch boundary; this worker proves the protocol
+end-to-end: the parent SIGTERMs process 0 only, and BOTH processes must
+report a drained run at the SAME step.
+
+Prints ``EPOCH_DONE <n>`` per epoch (every process, unbuffered — the
+parent times its signal off process 0's stream) and
+``PREEMPT_OK preempted=<bool> step=<n>`` after the loop returns.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    process_id = int(sys.argv[1])
+    num_processes = int(sys.argv[2])
+    port = sys.argv[3]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+    from tpu_ddp.train.trainer import TrainConfig, Trainer
+
+    config = TrainConfig(
+        synthetic_data=True,
+        synthetic_size=512,
+        epochs=40,  # far more than the drain needs: finishing naturally
+        per_shard_batch=8,  # means the signal/drain path failed
+        model="netresdeep",
+        n_chans1=8,
+        n_blocks=2,
+        log_every_epochs=1,
+        seed=0,
+    )
+    trainer = Trainer(config)
+
+    real_run_loop = trainer._run_loop
+
+    def run_loop_with_epoch_markers(c, start):
+        # piggyback per-epoch markers for the parent's signal timing:
+        # wrap set_epoch, which the loop calls once per epoch on every host
+        real_set_epoch = trainer.train_loader.set_epoch
+
+        def marked_set_epoch(epoch):
+            if epoch > 1:
+                print(f"EPOCH_DONE {epoch - 1}", flush=True)
+            return real_set_epoch(epoch)
+
+        trainer.train_loader.set_epoch = marked_set_epoch
+        return real_run_loop(c, start)
+
+    trainer._run_loop = run_loop_with_epoch_markers
+    metrics = trainer.run()
+    print(
+        f"PREEMPT_OK preempted={bool(metrics.get('preempted'))} "
+        f"step={int(trainer.state.step)}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

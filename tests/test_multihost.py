@@ -55,3 +55,64 @@ def test_two_process_trainer_batch_assembly_and_step():
         if line.startswith("MULTIHOST_OK")
     ]
     assert len(losses) == 2 and losses[0] == losses[1], losses
+
+
+def test_two_process_preemption_drain_agreement():
+    """SIGTERM lands on process 0 ONLY; both processes must drain at the
+    SAME step via the epoch-boundary process_allgather agreement
+    (Trainer._preempt_agreed) — a host breaking out unilaterally would
+    deadlock the other's collectives."""
+    import signal
+
+    port = _free_port()
+    worker = os.path.join(
+        os.path.dirname(__file__), "multihost_preempt_worker.py"
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU runtime
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-u", worker, str(i), "2", str(port)],
+            env=env, cwd=repo_root,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    import threading
+
+    watchdog = threading.Timer(420, lambda: [p.kill() for p in procs])
+    watchdog.start()
+    try:
+        # wait until process 0 finishes an epoch, then TERM it (only it).
+        # The readline blocks; the watchdog above unwedges a silent worker.
+        for line in procs[0].stdout:
+            if line.startswith("EPOCH_DONE"):
+                break
+        procs[0].send_signal(signal.SIGTERM)
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        watchdog.cancel()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    # NOTE: process 0's pre-signal lines were consumed by the readline loop
+    # above, so its `out` holds only post-signal output — PREEMPT_OK is
+    # always post-signal, so the marker scan is unaffected.
+    markers = []
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} rc={p.returncode}:\n{out[-3000:]}"
+        found = [l for l in out.splitlines() if l.startswith("PREEMPT_OK")]
+        assert found, f"worker {i} never drained:\n{out[-3000:]}"
+        markers.append(found[-1])
+    steps = []
+    for m in markers:
+        assert "preempted=True" in m, markers
+        steps.append(int(m.split("step=")[1]))
+    assert steps[0] == steps[1], f"drained at different steps: {markers}"
