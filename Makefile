@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test dryrun bench smoke
+.PHONY: test dryrun bench smoke capture
 
 test:
 	$(PYTHON) -m pytest tests/ -x -q
@@ -15,6 +15,12 @@ dryrun:
 
 bench:
 	$(PYTHON) bench.py
+
+# Opportunistic on-chip evidence: probes the (intermittently available)
+# TPU runtime and, when it's up, records each bench leg into
+# benchmarks/bench_tpu.json + attempts.jsonl. No-op when wedged.
+capture:
+	$(PYTHON) benchmarks/capture_tpu.py
 
 # 2-epoch end-to-end CLI run on the virtual mesh (fast sanity check).
 smoke:
