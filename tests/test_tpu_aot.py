@@ -1,0 +1,78 @@
+"""Deviceless AOT compilation against the REAL XLA:TPU + Mosaic toolchain.
+
+The image ships ``libtpu``; ``jax.experimental.topologies`` builds
+compile-only v5e topologies (exact bench device kind, "TPU v5 lite"), so
+the Mosaic kernels and sharded train steps are validated by the real TPU
+compiler in CI — one step short of execution (see
+``benchmarks/aot_v5e.py`` for the full committed suite incl. the 2-host
+topology and ResNet-50 bf16 memory analysis)."""
+
+import importlib
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+
+@pytest.fixture(scope="module")
+def v5e_topo():
+    from jax.experimental import topologies
+
+    try:
+        topo = topologies.get_topology_desc("v5e:2x2", "tpu")
+    except Exception as e:  # no libtpu in this environment
+        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    assert topo.devices[0].device_kind == "TPU v5 lite"
+    return topo
+
+
+def test_flash_kernels_compile_for_v5e(v5e_topo):
+    """Forward AND backward Pallas kernels pass the real Mosaic compiler
+    for the bench target device kind (not just StableHLO lowering)."""
+    fa = importlib.import_module("tpu_ddp.ops.flash_attention")
+    from tpu_ddp.parallel import MeshSpec, create_mesh
+
+    one = create_mesh(MeshSpec(data=1), v5e_topo.devices[:1])
+    repl = jax.sharding.NamedSharding(one, jax.sharding.PartitionSpec())
+    qs = jax.ShapeDtypeStruct((4, 256, 2, 64), jnp.float32, sharding=repl)
+
+    fwd = jax.jit(lambda a, b, c: fa.flash_attention(a, b, c, 128, 128, False))
+    compiled = fwd.trace(qs, qs, qs).lower().compile()
+    assert compiled.memory_analysis() is not None
+
+    bwd = jax.jit(jax.grad(
+        lambda a, b, c: fa.flash_attention(a, b, c, 128, 128, False).sum(),
+        (0, 1, 2),
+    ))
+    compiled_bwd = bwd.trace(qs, qs, qs).lower().compile()
+    assert compiled_bwd.memory_analysis() is not None
+
+
+def test_dp_step_compiles_for_v5e_mesh(v5e_topo):
+    """The shard_map DP train step (collectives included) compiles for a
+    4-chip v5e slice with the real TPU toolchain."""
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
+    from tpu_ddp.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    mesh = create_mesh(MeshSpec(data=-1), v5e_topo.devices)
+    model = NetResDeep(n_chans1=8, n_blocks=2)
+    tx = make_optimizer(lr=1e-2)
+    state = jax.eval_shape(
+        lambda: create_train_state(model, tx, jax.random.key(0))
+    )
+    step = make_train_step(model, tx, mesh)
+    bs = batch_sharding(mesh)
+    batch = {
+        "image": jax.ShapeDtypeStruct((32, 32, 32, 3), jnp.float32,
+                                      sharding=bs),
+        "label": jax.ShapeDtypeStruct((32,), jnp.int32, sharding=bs),
+        "mask": jax.ShapeDtypeStruct((32,), bool, sharding=bs),
+    }
+    compiled = step.trace(state, batch).lower().compile()
+    ma = compiled.memory_analysis()
+    assert ma is not None and ma.temp_size_in_bytes >= 0
