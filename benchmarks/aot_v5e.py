@@ -41,6 +41,14 @@ os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
 sys.path.insert(0, _REPO)
 
 import jax  # noqa: E402
+
+# The env vars above are too late for a process whose sitecustomize already
+# imported jax (this environment's TPU plugin does exactly that): the
+# jax_platforms config read the original env at import time. Force it —
+# one real-array creation against the default backend would otherwise
+# initialize the (pool-granted, possibly wedged) axon platform and hang.
+jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp  # noqa: E402
 
 
@@ -98,12 +106,13 @@ def main() -> None:
     mesh = create_mesh(MeshSpec(data=-1), topo.devices)
     bs = batch_sharding(mesh)
 
-    def batch_for(gb, dtype=jnp.float32):
+    def batch_for(n_rows, sharding=None):
+        sh = bs if sharding is None else sharding
         return {
-            "image": jax.ShapeDtypeStruct((gb, 32, 32, 3), jnp.float32,
-                                          sharding=bs),
-            "label": jax.ShapeDtypeStruct((gb,), jnp.int32, sharding=bs),
-            "mask": jax.ShapeDtypeStruct((gb,), bool, sharding=bs),
+            "image": jax.ShapeDtypeStruct((n_rows, 32, 32, 3), jnp.float32,
+                                          sharding=sh),
+            "label": jax.ShapeDtypeStruct((n_rows,), jnp.int32, sharding=sh),
+            "mask": jax.ShapeDtypeStruct((n_rows,), bool, sharding=sh),
         }
 
     # 1. Flagship DP shard_map step (NetResDeep, the reference recipe).
@@ -183,12 +192,133 @@ def main() -> None:
 
     progs["tp_vit_2x4"] = _compile("tp_vit_2x4", tp_compile)
 
+    # 5-8. The remaining parallel families, mirroring the dryrun legs
+    # (__graft_entry__) in compile-only form. States are abstractified
+    # (ShapeDtypeStruct + the builder's shardings) — compile-only devices
+    # cannot hold real arrays.
+    import numpy as np
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    def _abstract(tree, shardings=None):
+        ab = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree
+        )
+        if shardings is None:
+            return ab
+        return jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            ab, shardings,
+        )
+
+    from tpu_ddp.models.vit import ViT
+
+    def fsdp_compile():
+        from tpu_ddp.parallel.tensor_parallel import make_fsdp_train_step
+
+        vit = ViT(patch_size=8, hidden_dim=64, depth=2, num_heads=4)
+        vtx = make_optimizer(lr=1e-2, momentum=0.9)
+        vstate = jax.eval_shape(
+            lambda: create_train_state(vit, vtx, jax.random.key(0))
+        )
+        vstep, shardings = make_fsdp_train_step(vit, vtx, mesh, vstate)
+        return vstep.trace(
+            _abstract(vstate, shardings), batch_for(8 * 4)
+        ).lower().compile()
+
+    progs["fsdp_vit_zero3_x8"] = _compile("fsdp_vit_zero3_x8", fsdp_compile)
+
+    def fsdp_tp_compile():
+        from tpu_ddp.parallel.tensor_parallel import make_fsdp_tp_train_step
+
+        devs = np.asarray(topo.devices).reshape(2, 4)
+        m2 = Mesh(devs, ("data", "model"))
+        vit = ViT(patch_size=8, hidden_dim=128, depth=2, num_heads=4)
+        vtx = make_optimizer(lr=1e-2, momentum=0.9)
+        vstate = jax.eval_shape(
+            lambda: create_train_state(vit, vtx, jax.random.key(0))
+        )
+        vstep, shardings = make_fsdp_tp_train_step(vit, vtx, m2, vstate)
+        dbs = NamedSharding(m2, P("data"))
+        return vstep.trace(
+            _abstract(vstate, shardings), batch_for(2 * 4, dbs)
+        ).lower().compile()
+
+    progs["fsdp_tp_vit_2x4"] = _compile("fsdp_tp_vit_2x4", fsdp_tp_compile)
+
+    def pp_compile():
+        from tpu_ddp.parallel.pipeline import (
+            create_pp_train_state,
+            make_pp_train_step,
+        )
+
+        devs = np.asarray(topo.devices).reshape(2, 4)
+        m2 = Mesh(devs, ("data", "pipeline"))
+        vit = ViT(patch_size=8, hidden_dim=64, depth=4, num_heads=4)
+        vtx = make_optimizer(lr=1e-2, momentum=0.9)
+        # abstract: a real-array state would touch the default backend
+        pp_state = jax.eval_shape(
+            lambda: create_pp_train_state(vit, vtx, jax.random.key(0))
+        )
+        vstep, shardings = make_pp_train_step(
+            vit, vtx, m2, pp_state, n_microbatches=2
+        )
+        dbs = NamedSharding(m2, P("data"))
+        return vstep.trace(
+            _abstract(pp_state, shardings), batch_for(2 * 4, dbs)
+        ).lower().compile()
+
+    progs["pp_vit_gpipe_2x4"] = _compile("pp_vit_gpipe_2x4", pp_compile)
+
+    def ep_compile():
+        from tpu_ddp.models.moe import MoEViT
+        from tpu_ddp.parallel.expert_parallel import make_ep_train_step
+
+        devs = np.asarray(topo.devices).reshape(2, 4)
+        m2 = Mesh(devs, ("data", "expert"))
+        moe = MoEViT(patch_size=8, hidden_dim=32, depth=2, num_heads=2,
+                     num_experts=4, moe_every=2)
+        vtx = make_optimizer(lr=1e-2, momentum=0.9)
+        vstate = jax.eval_shape(
+            lambda: create_train_state(moe, vtx, jax.random.key(0))
+        )
+        vstep, shardings = make_ep_train_step(moe, vtx, m2, vstate)
+        dbs = NamedSharding(m2, P("data"))
+        return vstep.trace(
+            _abstract(vstate, shardings), batch_for(2 * 4, dbs)
+        ).lower().compile()
+
+    progs["ep_moe_vit_2x4"] = _compile("ep_moe_vit_2x4", ep_compile)
+
+    def sp_compile():
+        from tpu_ddp.parallel.sequence_parallel import make_sp_train_step
+
+        devs = np.asarray(topo.devices).reshape(4, 2)
+        m2 = Mesh(devs, ("data", "sequence"))
+        sp_model = ViT(depth=2, hidden_dim=32, num_heads=2,
+                       sp_axis="sequence")
+        ref_model = ViT(depth=2, hidden_dim=32, num_heads=2)
+        vtx = make_optimizer(lr=1e-2)
+        vstate = jax.eval_shape(
+            lambda: create_train_state(ref_model, vtx, jax.random.key(0))
+        )
+        vstep = make_sp_train_step(sp_model, vtx, m2)
+        dbs = NamedSharding(m2, P("data"))
+        return vstep.trace(
+            _abstract(vstate), batch_for(4 * 2, dbs)
+        ).lower().compile()
+
+    progs["sp_ring_attention_4x2"] = _compile(
+        "sp_ring_attention_4x2", sp_compile
+    )
+
     results["all_ok"] = all(p.get("ok") for p in progs.values())
     tmp = _OUT + ".tmp"
     with open(tmp, "w") as f:
         json.dump(results, f, indent=1)
     os.replace(tmp, _OUT)
     print(f"aot_v5e: wrote {_OUT} (all_ok={results['all_ok']})", flush=True)
+    sys.exit(0 if results["all_ok"] else 1)
 
 
 if __name__ == "__main__":
