@@ -166,11 +166,11 @@ def main() -> None:
     from tpu_ddp.models.vit import ViT
     from tpu_ddp.parallel.tensor_parallel import make_tp_train_step
 
+    import numpy as np
+
+    from jax.sharding import Mesh
+
     def tp_compile():
-        import numpy as np
-
-        from jax.sharding import Mesh
-
         devs = np.asarray(topo.devices).reshape(2, 4)
         tp_mesh = Mesh(devs, ("data", "model"))
         vit = ViT(patch_size=8, hidden_dim=128, depth=2, num_heads=4)
@@ -196,8 +196,6 @@ def main() -> None:
     # (__graft_entry__) in compile-only form. States are abstractified
     # (ShapeDtypeStruct + the builder's shardings) — compile-only devices
     # cannot hold real arrays.
-    import numpy as np
-
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     def _abstract(tree, shardings=None):
@@ -210,8 +208,6 @@ def main() -> None:
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
             ab, shardings,
         )
-
-    from tpu_ddp.models.vit import ViT
 
     def fsdp_compile():
         from tpu_ddp.parallel.tensor_parallel import make_fsdp_train_step

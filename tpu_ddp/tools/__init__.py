@@ -1,0 +1,1 @@
+"""Operator tools built on deviceless AOT compilation (no chip needed)."""

@@ -1,0 +1,184 @@
+"""HBM capacity planning: will this training config fit on the chip?
+
+``python -m tpu_ddp.tools.memplan --model resnet50 --batch-size 256
+--compute-dtype bfloat16 [--remat] [--topology v5e:2x2] [--n-devices 4]``
+
+Compiles the REAL train step for the requested model/batch/dtype with the
+real XLA:TPU + Mosaic toolchain against a deviceless topology (the image's
+``libtpu``; no chip, no TPU runtime, safe on a CPU-only host) and reports
+the compiler's own per-device memory analysis — arguments (params +
+optimizer state + batch), outputs, and temp (activations/workspace) — next
+to the device's HBM capacity. This answers the question the reference's
+dead ``free_gpu_cache`` utility (``/root/reference/main.py:67-78``) was
+groping at, with the compiler's ground truth instead of post-hoc
+utilization prints.
+
+The ``--remat`` flag makes the memory/FLOPs trade measurable: run twice
+and diff ``temp_size``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+# HBM per chip by device kind (bytes). v5e = 16 GiB.
+_HBM_BYTES = {
+    "TPU v5 lite": 16 * 1024**3,
+    "TPU v5": 95 * 1024**3,
+    "TPU v4": 32 * 1024**3,
+    "TPU v6 lite": 32 * 1024**3,
+}
+
+
+def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
+         remat: bool, topology: str, n_devices: int | None,
+         momentum: float = 0.9, image_size: int | None = None,
+         num_classes: int | None = None) -> dict:
+    """Compile the DP train step for ``topology`` and return the memory
+    report dict. Raises on compile failure (a real regression).
+
+    ``image_size``/``num_classes`` default per model: vit_b16 is an
+    ImageNet-scale model (224x224, 1000 classes) — compiling it on CIFAR
+    shapes would underestimate activation memory ~49x; everything else
+    defaults to CIFAR (32, 10)."""
+    import jax
+
+    if image_size is None:
+        image_size = 224 if model_name == "vit_b16" else 32
+    if num_classes is None:
+        num_classes = 1000 if model_name == "vit_b16" else 10
+
+    # Deviceless everywhere: this must be runnable while the real TPU
+    # runtime is wedged/held (jax may already be imported by the
+    # environment's sitecustomize, so set the config, not just the env).
+    # Precautionary (nothing here touches a backend: states are abstract,
+    # compiles are AOT) — restored on exit so a live-process caller keeps
+    # its platform.
+    prev_platforms = jax.config.jax_platforms
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        return _plan_inner(
+            model_name, per_shard_batch, compute_dtype=compute_dtype,
+            remat=remat, topology=topology, n_devices=n_devices,
+            momentum=momentum, image_size=image_size,
+            num_classes=num_classes,
+        )
+    finally:
+        jax.config.update("jax_platforms", prev_platforms)
+
+
+def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
+                topology, n_devices, momentum, image_size, num_classes):
+    import jax
+
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+    from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
+    from tpu_ddp.train import (
+        create_train_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    topo = topologies.get_topology_desc(topology, "tpu")
+    devices = topo.devices[: n_devices or len(topo.devices)]
+    kind = devices[0].device_kind
+    mesh = create_mesh(MeshSpec(data=-1), devices)
+
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[compute_dtype]
+    if model_name == "netresdeep":
+        model = NetResDeep(dtype=dtype)
+    else:
+        model = MODEL_REGISTRY[model_name](num_classes=num_classes,
+                                           dtype=dtype)
+    tx = make_optimizer(lr=1e-1, momentum=momentum)
+    state = jax.eval_shape(
+        lambda: create_train_state(
+            model, tx, jax.random.key(0),
+            input_shape=(1, image_size, image_size, 3),
+        )
+    )
+    step = make_train_step(model, tx, mesh, remat=remat)
+
+    gb = per_shard_batch * len(devices)
+    bs = batch_sharding(mesh)
+    batch = {
+        "image": jax.ShapeDtypeStruct((gb, image_size, image_size, 3),
+                                      jnp.float32, sharding=bs),
+        "label": jax.ShapeDtypeStruct((gb,), jnp.int32, sharding=bs),
+        "mask": jax.ShapeDtypeStruct((gb,), bool, sharding=bs),
+    }
+    compiled = step.trace(state, batch).lower().compile()
+    ma = compiled.memory_analysis()
+    arg = int(ma.argument_size_in_bytes)
+    out = int(ma.output_size_in_bytes)
+    temp = int(ma.temp_size_in_bytes)
+    hbm = _HBM_BYTES.get(kind)
+    # Steady state: donated inputs alias outputs, so peak is roughly
+    # args + temp (the compiler's temp already includes the working set).
+    peak = arg + temp
+    return {
+        "model": model_name,
+        "image_size": image_size,
+        "num_classes": num_classes,
+        "per_shard_batch": per_shard_batch,
+        "n_devices": len(devices),
+        "compute_dtype": compute_dtype,
+        "remat": remat,
+        "device_kind": kind,
+        "per_device": {
+            "argument_bytes": arg,
+            "output_bytes": out,
+            "temp_bytes": temp,
+            "est_peak_bytes": peak,
+        },
+        "hbm_bytes": hbm,
+        "fits": (peak < hbm) if hbm else None,
+        "hbm_fraction": round(peak / hbm, 4) if hbm else None,
+    }
+
+
+def main(argv=None) -> dict:
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+
+    p = argparse.ArgumentParser(description="HBM capacity planner (AOT)")
+    p.add_argument("--model", default="netresdeep",
+                   choices=["netresdeep"] + sorted(MODEL_REGISTRY))
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="per-shard batch")
+    p.add_argument("--compute-dtype", choices=["float32", "bfloat16"],
+                   default="float32")
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--topology", default="v5e:2x2",
+                   help='deviceless slice, e.g. "v5e:2x2", "v5e:2x4"')
+    p.add_argument("--n-devices", type=int, default=None,
+                   help="use only the first N topology devices")
+    p.add_argument("--image-size", type=int, default=None,
+                   help="input side length (default: model-aware — 224 "
+                        "for vit_b16, else 32)")
+    p.add_argument("--num-classes", type=int, default=None,
+                   help="default: model-aware — 1000 for vit_b16, else 10")
+    args = p.parse_args(argv)
+    report = plan(
+        args.model, args.batch_size, compute_dtype=args.compute_dtype,
+        remat=args.remat, topology=args.topology, n_devices=args.n_devices,
+        momentum=args.momentum, image_size=args.image_size,
+        num_classes=args.num_classes,
+    )
+    print(json.dumps(report, indent=1))
+    if report["fits"] is False:
+        print(f"memplan: DOES NOT FIT ({report['hbm_fraction']:.1%} of "
+              f"{report['device_kind']} HBM)", file=sys.stderr)
+        sys.exit(1)  # preflight scripts must be able to gate on the verdict
+    return report
+
+
+if __name__ == "__main__":
+    main()
