@@ -308,6 +308,47 @@ def main() -> None:
         "sp_ring_attention_4x2", sp_compile
     )
 
+    # 9. Pod-scale sweep: the same SPMD programs compiled for full v5e
+    # pods (compile cost is scale-invariant — one partitioned program).
+    # The largest v5e slice is 16x16 = 256 chips over 64 hosts.
+    def scale_leg(pod: str, family: str):
+        def compile_pod():
+            ptopo = topologies.get_topology_desc(pod, "tpu")
+            n = len(ptopo.devices)
+            if family == "dp":
+                pmesh = create_mesh(MeshSpec(data=-1), ptopo.devices)
+                pstate = state  # abstract; mesh-independent
+                pstep = make_train_step(model, tx, pmesh)
+                pbs = batch_sharding(pmesh)
+                return pstep.trace(
+                    pstate, batch_for(32 * n, pbs)
+                ).lower().compile()
+            if family == "fsdp":
+                from tpu_ddp.parallel.tensor_parallel import (
+                    make_fsdp_train_step,
+                )
+
+                pmesh = create_mesh(MeshSpec(data=-1), ptopo.devices)
+                vit = ViT(patch_size=8, hidden_dim=256, depth=4, num_heads=4)
+                vtx = make_optimizer(lr=1e-2, momentum=0.9)
+                vstate = jax.eval_shape(
+                    lambda: create_train_state(vit, vtx, jax.random.key(0))
+                )
+                vstep, shardings = make_fsdp_train_step(
+                    vit, vtx, pmesh, vstate
+                )
+                pbs = batch_sharding(pmesh)
+                return vstep.trace(
+                    _abstract(vstate, shardings), batch_for(4 * n, pbs)
+                ).lower().compile()
+            raise ValueError(family)
+
+        return _compile(f"pod_{family}_{pod.replace(':', '_')}", compile_pod)
+
+    for pod in ("v5e:8x8", "v5e:16x16"):
+        progs[f"pod_dp_{pod.replace(':', '_')}"] = scale_leg(pod, "dp")
+    progs["pod_fsdp_v5e_16x16"] = scale_leg("v5e:16x16", "fsdp")
+
     results["all_ok"] = all(p.get("ok") for p in progs.values())
     tmp = _OUT + ".tmp"
     with open(tmp, "w") as f:

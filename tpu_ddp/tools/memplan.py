@@ -24,12 +24,15 @@ import json
 import sys
 
 
-# HBM per chip by device kind (bytes). v5e = 16 GiB.
+# HBM per chip by device kind (bytes), in the DECIMAL units the chip
+# specs are quoted in (v5e = 16 GB, v5p = 95 GB, v4 = 32 GB, v6e = 32 GB):
+# mixing GiB multipliers with decimal specs would overstate capacity and
+# flip the fit verdict near the boundary.
 _HBM_BYTES = {
-    "TPU v5 lite": 16 * 1024**3,
-    "TPU v5": 95 * 1024**3,
-    "TPU v4": 32 * 1024**3,
-    "TPU v6 lite": 32 * 1024**3,
+    "TPU v5 lite": 16_000_000_000,
+    "TPU v5": 95_000_000_000,
+    "TPU v4": 32_000_000_000,
+    "TPU v6 lite": 32_000_000_000,
 }
 
 
@@ -87,13 +90,24 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
     )
 
     topo = topologies.get_topology_desc(topology, "tpu")
-    devices = topo.devices[: n_devices or len(topo.devices)]
+    if n_devices is not None and n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    devices = (topo.devices[:n_devices] if n_devices is not None
+               else topo.devices)
     kind = devices[0].device_kind
     mesh = create_mesh(MeshSpec(data=-1), devices)
 
     dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[compute_dtype]
     if model_name == "netresdeep":
         model = NetResDeep(dtype=dtype)
+    elif model_name.startswith("resnet"):
+        # ImageNet-size inputs get the ImageNet stem (7x7-s2 + maxpool);
+        # the CIFAR stem at 224x224 would plan ~16x the real stage-1
+        # activations for a model nobody trains that way.
+        model = MODEL_REGISTRY[model_name](
+            num_classes=num_classes, dtype=dtype,
+            cifar_stem=(image_size <= 64),
+        )
     else:
         model = MODEL_REGISTRY[model_name](num_classes=num_classes,
                                            dtype=dtype)
