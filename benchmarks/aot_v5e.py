@@ -198,16 +198,7 @@ def main() -> None:
     # cannot hold real arrays.
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    def _abstract(tree, shardings=None):
-        ab = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree
-        )
-        if shardings is None:
-            return ab
-        return jax.tree.map(
-            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-            ab, shardings,
-        )
+    from tpu_ddp.parallel.partitioning import abstract_train_state as _abstract
 
     def fsdp_compile():
         from tpu_ddp.parallel.tensor_parallel import make_fsdp_train_step

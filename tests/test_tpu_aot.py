@@ -92,3 +92,19 @@ def test_memplan_reports_fit_for_v5e(v5e_topo):
     assert per["argument_bytes"] > 0 and per["est_peak_bytes"] > 0
     assert report["fits"] is True  # 76K-param model: trivially fits
     assert 0 < report["hbm_fraction"] < 0.05
+
+
+def test_memplan_fsdp_scatters_state(v5e_topo):
+    """--parallelism fsdp must show the ZeRO-3 per-device state shrink in
+    the compiler's own argument bytes (params + opt state scattered over
+    the 4-device data axis; batch and non-shardable tensors remain)."""
+    from tpu_ddp.tools.memplan import plan
+
+    dp = plan("vit_s4", 32, compute_dtype="float32", remat=False,
+              topology="v5e:2x2", n_devices=None)
+    fs = plan("vit_s4", 32, compute_dtype="float32", remat=False,
+              topology="v5e:2x2", n_devices=None, parallelism="fsdp")
+    assert fs["parallelism"] == "fsdp"
+    # well under: state dominates this config, and it scatters 4 ways
+    assert (fs["per_device"]["argument_bytes"]
+            < 0.6 * dp["per_device"]["argument_bytes"])

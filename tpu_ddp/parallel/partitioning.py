@@ -169,3 +169,20 @@ def train_state_shardings(
 def shard_train_state(state: Any, shardings: Any) -> Any:
     """Lay an (unsharded / freshly-initialized) TrainState out on the mesh."""
     return jax.device_put(state, shardings)
+
+
+def abstract_train_state(state, shardings=None):
+    """ShapeDtypeStruct-ify a (possibly concrete) state pytree, attaching
+    ``shardings`` leaf-wise when given — the input format for deviceless
+    AOT compilation (compile-only topology devices cannot hold arrays)."""
+    import jax
+
+    ab = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(jax.numpy.shape(x), x.dtype), state
+    )
+    if shardings is None:
+        return ab
+    return jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        ab, shardings,
+    )

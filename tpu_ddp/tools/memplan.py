@@ -31,7 +31,7 @@ import sys
 _HBM_BYTES = {
     "TPU v5 lite": 16_000_000_000,
     "TPU v5": 95_000_000_000,
-    "TPU v4": 32_000_000_000,
+    "TPU v4": 32 * 1024**3,  # v4 is spec'd in GiB (32 GiB), unlike v5e/v5p
     "TPU v6 lite": 32_000_000_000,
 }
 
@@ -39,7 +39,8 @@ _HBM_BYTES = {
 def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
          remat: bool, topology: str, n_devices: int | None,
          momentum: float = 0.9, image_size: int | None = None,
-         num_classes: int | None = None) -> dict:
+         num_classes: int | None = None,
+         parallelism: str = "dp") -> dict:
     """Compile the DP train step for ``topology`` and return the memory
     report dict. Raises on compile failure (a real regression).
 
@@ -49,6 +50,10 @@ def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
     defaults to CIFAR (32, 10)."""
     import jax
 
+    if parallelism not in ("dp", "fsdp"):
+        raise ValueError(
+            f"parallelism must be 'dp' or 'fsdp', got {parallelism!r}"
+        )
     if image_size is None:
         image_size = 224 if model_name == "vit_b16" else 32
     if num_classes is None:
@@ -67,14 +72,15 @@ def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
             model_name, per_shard_batch, compute_dtype=compute_dtype,
             remat=remat, topology=topology, n_devices=n_devices,
             momentum=momentum, image_size=image_size,
-            num_classes=num_classes,
+            num_classes=num_classes, parallelism=parallelism,
         )
     finally:
         jax.config.update("jax_platforms", prev_platforms)
 
 
 def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
-                topology, n_devices, momentum, image_size, num_classes):
+                topology, n_devices, momentum, image_size, num_classes,
+                parallelism):
     import jax
 
     import jax.numpy as jnp
@@ -118,7 +124,26 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
             input_shape=(1, image_size, image_size, 3),
         )
     )
-    step = make_train_step(model, tx, mesh, remat=remat)
+    if parallelism == "fsdp":
+        # ZeRO-3: params + optimizer state scattered over the data axis —
+        # the per-device `argument_bytes` shows the 1/N state shrink with
+        # the compiler's own numbers.
+        from tpu_ddp.parallel.tensor_parallel import make_fsdp_train_step
+
+        if remat:
+            raise ValueError(
+                "--remat is not supported with --parallelism fsdp (the "
+                "ZeRO-3 step builder has no remat knob)"
+            )
+        from tpu_ddp.parallel.partitioning import abstract_train_state
+
+        step, shardings = make_fsdp_train_step(
+            model, tx, mesh, state,
+            has_batch_stats=bool(jax.tree.leaves(state.batch_stats)),
+        )
+        state = abstract_train_state(state, shardings)
+    else:
+        step = make_train_step(model, tx, mesh, remat=remat)
 
     gb = per_shard_batch * len(devices)
     bs = batch_sharding(mesh)
@@ -139,6 +164,7 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
     peak = arg + temp
     return {
         "model": model_name,
+        "parallelism": parallelism,
         "image_size": image_size,
         "num_classes": num_classes,
         "per_shard_batch": per_shard_batch,
@@ -169,6 +195,9 @@ def main(argv=None) -> dict:
     p.add_argument("--compute-dtype", choices=["float32", "bfloat16"],
                    default="float32")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--parallelism", choices=["dp", "fsdp"], default="dp",
+                   help="fsdp = ZeRO-3 state scatter: per-device "
+                        "argument_bytes shows the 1/N shrink")
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--topology", default="v5e:2x2",
                    help='deviceless slice, e.g. "v5e:2x2", "v5e:2x4"')
@@ -184,7 +213,7 @@ def main(argv=None) -> dict:
         args.model, args.batch_size, compute_dtype=args.compute_dtype,
         remat=args.remat, topology=args.topology, n_devices=args.n_devices,
         momentum=args.momentum, image_size=args.image_size,
-        num_classes=args.num_classes,
+        num_classes=args.num_classes, parallelism=args.parallelism,
     )
     print(json.dumps(report, indent=1))
     if report["fits"] is False:
