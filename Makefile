@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test dryrun bench smoke capture
+.PHONY: test dryrun bench smoke capture aot
 
 test:
 	$(PYTHON) -m pytest tests/ -x -q
@@ -21,6 +21,12 @@ bench:
 # benchmarks/bench_tpu.json + attempts.jsonl. No-op when wedged.
 capture:
 	$(PYTHON) benchmarks/capture_tpu.py
+
+# Deviceless AOT evidence: compiles all flagship programs with the real
+# XLA:TPU + Mosaic toolchain (no chip needed); exits nonzero on any
+# compile regression and rewrites benchmarks/aot_v5e.json.
+aot:
+	$(PYTHON) benchmarks/aot_v5e.py
 
 # 2-epoch end-to-end CLI run on the virtual mesh (fast sanity check).
 smoke:
