@@ -25,7 +25,7 @@ before its first byte of stdout). This rewrite is green by construction:
 The parent process NEVER imports jax (this environment's TPU plugin has
 hung backend init from shallow entry points; see ``__graft_entry__.py``).
 
-Two configs are measured on a real chip (VERDICT round-1 item 3):
+Configs measured on a real chip (VERDICT round-1 item 3):
 
 - **flagship** — NetResDeep, f32, per-shard batch 32: the reference recipe
   (``/root/reference/main.py:27,61``). Dispatch-bound at this size, so the

@@ -63,12 +63,47 @@ def _mem(compiled) -> dict:
     return out
 
 
+_HLO_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+            "collective-permute", "all-to-all", "convolution", "fusion",
+            "custom-call")
+
+
+def _hlo_ops(compiled) -> dict:
+    """INSTRUCTION counts of the load-bearing ops in the OPTIMIZED HLO —
+    where the sharding design becomes visible (DP shows the bucketed grad
+    all-reduce, PP its collective-permute rotation, EP the token
+    all-to-all, the Pallas kernels their custom-calls). Counts opcode
+    definition sites (`= <type> <opcode>(`): raw substring counts would be
+    inflated by instruction names, operand uses, and -start/-done async
+    variants."""
+    import re
+
+    try:
+        txt = compiled.as_text()
+    except Exception:
+        return {}
+    # An opcode definition site reads `= <result-type> <opcode>(`; the
+    # result type ends with `]` (array), `}` (layout), or `)` (tuple — how
+    # bucketed collectives appear), so anchor on that instead of \S+
+    # (which misses tuple types containing spaces).
+    found = re.findall(
+        r"[\]})] (" + "|".join(_HLO_OPS) + r")(?:-start)?\(", txt
+    )
+    out: dict = {}
+    for op in found:
+        out[op] = out.get(op, 0) + 1
+    return out
+
+
 def _compile(name: str, fn_trace) -> dict:
     t0 = time.time()
     try:
         compiled = fn_trace()
         rec = {"ok": True, "compile_wall_s": round(time.time() - t0, 1),
                **_mem(compiled)}
+        ops = _hlo_ops(compiled)
+        if ops:
+            rec["hlo_ops"] = ops
     except Exception as e:  # record the failure; keep compiling the rest
         rec = {"ok": False, "compile_wall_s": round(time.time() - t0, 1),
                "error": f"{type(e).__name__}: {e}"[:500]}

@@ -220,7 +220,9 @@ def main(argv=None) -> dict:
         print(f"memplan: DOES NOT FIT ({report['hbm_fraction']:.1%} of "
               f"{report['device_kind']} HBM)", file=sys.stderr)
         sys.exit(1)  # preflight scripts must be able to gate on the verdict
-    return report
+    # console-script entry point does sys.exit(main()): returning the dict
+    # would exit 1 on every SUCCESSFUL run
+    return 0
 
 
 if __name__ == "__main__":
