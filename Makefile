@@ -3,9 +3,15 @@
 
 PYTHON ?= python
 
-.PHONY: test dryrun bench smoke capture aot
+.PHONY: test test-all dryrun bench smoke capture aot
 
+# Fast default loop (round-3 verdict item 5): skips the `slow`-marked
+# multi-process / end-to-end-CLI / AOT tests. CI and pre-commit should run
+# `make test-all` at least once; `make test` is the between-commits loop.
 test:
+	$(PYTHON) -m pytest tests/ -x -q -m "not slow"
+
+test-all:
 	$(PYTHON) -m pytest tests/ -x -q
 
 # The driver's multi-chip validation: compiles + runs every parallelism

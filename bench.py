@@ -457,7 +457,47 @@ def child_main(quick: bool) -> None:
     else:
         out["compute_bound"] = {"skipped": "non-TPU backend (bf16 emulated)"}
         out["attention_bench"] = {"skipped": "non-TPU backend"}
+    _promote_compute_headline(out)
     _emit(out)
+
+
+def _promote_compute_headline(out: dict) -> None:
+    """Round-3 verdict item 7: one ``value`` field must not conflate
+    dispatch-fusion throughput (the 76K-param flagship, a number dominated
+    by scan amortization) with compute throughput. Both configs become
+    named ``rows``; when the compute-bound leg has a number it IS the
+    headline (top-level metric/value/mfu). ``vs_baseline`` stays the
+    framework-vs-reference-pattern ratio on the reference's own model (the
+    flagship row) — ``vs_baseline_row`` says so explicitly."""
+    flagship_row = {
+        "metric": "cifar10_train_images_per_sec_per_chip",
+        "value": out.get("value"),
+        "unit": "images/sec/chip",
+        "mfu": out.get("mfu"),
+        "vs_baseline": out.get("vs_baseline"),
+        "vs_baseline_source": out.get("vs_baseline_source"),
+        "note": "scan-fused dispatch throughput on the 76K-param reference "
+                "model; measures dispatch amortization, not MXU compute",
+    }
+    rows = {"dispatch_fused_flagship": flagship_row}
+    cb = out.get("compute_bound") or {}
+    cb_v = cb.get("images_per_sec_per_chip") if isinstance(cb, dict) else None
+    if cb_v:
+        rows["compute_bound_resnet50_bf16"] = {
+            "metric": "resnet50_bf16_train_images_per_sec_per_chip",
+            "value": cb_v,
+            "unit": "images/sec/chip",
+            "mfu": cb.get("mfu"),
+            "note": "compute-bound config: ResNet-50 bf16, the MXU number",
+        }
+        out["metric"] = "resnet50_bf16_train_images_per_sec_per_chip"
+        out["value"] = cb_v
+        out["mfu"] = cb.get("mfu")
+        out["headline_row"] = "compute_bound_resnet50_bf16"
+    else:
+        out["headline_row"] = "dispatch_fused_flagship"
+    out["vs_baseline_row"] = "dispatch_fused_flagship"
+    out["rows"] = rows
 
 
 # ---------------------------------------------------------------- parent --
@@ -578,7 +618,6 @@ def main() -> None:
     )
 
     ok, info = _probe_backend(dict(os.environ))
-    _record_attempt("probe", ok=ok, info=info)
     if ok and isinstance(info, dict) and info.get("backend") == "cpu":
         # The runtime fell back to the CPU backend (wedged TPU with a
         # cpu-permitting platform config): the full non-quick bench is
@@ -586,6 +625,9 @@ def main() -> None:
         # timed out when this happened) — go straight to the quick path.
         ok = False
         info = f"probe landed on cpu backend: {info}"
+    # record AFTER the downgrade so the append-only evidence log agrees
+    # with the path actually taken
+    _record_attempt("probe", ok=ok, info=info)
     if ok:
         timeout_s = max(60.0, _remaining() - 120)
         result, err = _run_child(

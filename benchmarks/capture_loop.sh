@@ -1,0 +1,32 @@
+#!/bin/bash
+# Retry on-chip capture until every target leg lands or the round ends.
+# capture_tpu.py probes first and exits 0 without queueing when the pool is
+# wedged, so looping it is grant-safe. One loop instance at a time. Each
+# iteration requests ONLY the still-missing legs: grant time on the
+# one-client pool is precious, and a re-run would clobber an
+# already-captured number with a noisier one.
+cd /root/repo
+LOCK=/tmp/tpu_capture_loop.lock
+exec 9>"$LOCK"
+flock -n 9 || { echo "capture loop already running"; exit 0; }
+DEADLINE=$(( $(date +%s) + 11*3600 ))
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  MISSING=$(python - <<'EOF'
+import json
+try:
+    doc = json.load(open("benchmarks/bench_tpu.json"))
+except Exception:
+    doc = {}
+legs = ("baseline", "compute", "attention", "sweep")
+print(",".join(k for k in legs if k not in doc))
+EOF
+)
+  if [ -z "$MISSING" ]; then
+    echo "all target legs captured; loop done"
+    exit 0
+  fi
+  python benchmarks/capture_tpu.py --legs "$MISSING" --leg-timeout 900 \
+    >> benchmarks/capture_r4.log 2>&1
+  sleep 720
+done
+echo "capture loop deadline reached"

@@ -122,8 +122,9 @@ def _on_term(signum, frame):
 def _probe(timeout: float = 75.0):
     # Explicit timeout: bench's internal probe window is tied to ITS
     # driver-budget accounting; this long-session tool affords a wider one.
-    ok, info = bench._probe_backend(dict(os.environ), timeout=timeout)
-    return info if ok else None
+    # Returns (ok, info); info carries the failure reason on not-ok so the
+    # attempts log can distinguish a wedged timeout from a cpu fallback.
+    return bench._probe_backend(dict(os.environ), timeout=timeout)
 
 
 def _run_leg(name: str, timeout: float):
@@ -168,11 +169,11 @@ def main() -> None:
     args = ap.parse_args()
     signal.signal(signal.SIGTERM, _on_term)
 
-    info = _probe()
-    if info is None or info.get("backend") == "cpu":
-        print("capture_tpu: runtime unavailable (wedged or CPU-only); "
-              "nothing attempted", flush=True)
-        _record("capture_probe", ok=False)
+    ok, info = _probe()
+    if not ok or (isinstance(info, dict) and info.get("backend") == "cpu"):
+        print(f"capture_tpu: runtime unavailable (wedged or CPU-only); "
+              f"nothing attempted: {info}", flush=True)
+        _record("capture_probe", ok=False, info=info)
         return
     print(f"capture_tpu: chip up: {info}", flush=True)
     _record("capture_probe", ok=True, info=info)
@@ -195,6 +196,18 @@ def main() -> None:
         if result is not None:
             doc[leg] = {"captured_unix_ts": round(time.time(), 1),
                         "wall_s": round(wall, 1), **result}
+            cb = doc.get("compute") or {}
+            if cb.get("images_per_sec_per_chip"):
+                # round-3 verdict item 7: once a compute-bound number
+                # exists it is the headline; the scan-fused flagship stays
+                # as its own row (doc["flagship"]), never conflated
+                doc["headline"] = {
+                    "metric": "resnet50_bf16_train_images_per_sec_per_chip",
+                    "value": cb["images_per_sec_per_chip"],
+                    "unit": "images/sec/chip",
+                    "mfu": cb.get("mfu"),
+                    "headline_row": "compute",
+                }
             _write_doc(doc)
         print(f"capture_tpu: leg {leg} -> "
               f"{'ok' if result else err} [{wall:.0f}s]", flush=True)

@@ -14,6 +14,10 @@ sys.path.insert(0, _REPO)
 
 import bench  # noqa: E402  (stdlib-only at module level)
 
+import pytest  # noqa: E402
+
+pytestmark = pytest.mark.slow  # subprocess-heavy: make test-all
+
 
 def _scrubbed_env():
     env = dict(os.environ)

@@ -203,6 +203,7 @@ def test_trainer_bce_and_predict(devices):
     assert np.isfinite(out["mAP"])
 
 
+@pytest.mark.slow  # kills/relaunches real training processes
 def test_preemption_checkpoints_and_resumes(tmp_path):
     """SIGTERM mid-training drains at the next batch boundary, writes a
     final checkpoint, and exits cleanly; --resume continues from it. The
@@ -277,6 +278,7 @@ def test_preemption_checkpoints_and_resumes(tmp_path):
     assert np.isfinite(result["test_accuracy"])
 
 
+@pytest.mark.slow  # kills/relaunches real training processes
 def test_midepoch_resume_matches_uninterrupted_run(tmp_path, devices):
     """A checkpoint written mid-epoch (what preemption produces) resumes by
     skipping the already-trained prefix of that epoch — the final params

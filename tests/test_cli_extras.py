@@ -8,6 +8,10 @@ import numpy as np
 
 from tpu_ddp.cli.train import main
 
+import pytest
+
+pytestmark = pytest.mark.slow  # e2e CLI runs: make test-all
+
 
 def test_cv_mode_cli(tmp_path):
     metrics = main([

@@ -14,6 +14,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-process / e2e-CLI / AOT: make test-all
+
 
 def _free_port() -> int:
     with socket.socket() as s:

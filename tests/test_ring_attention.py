@@ -3,6 +3,7 @@ attention across an 8-device sequence-sharded mesh."""
 
 import jax
 import jax.numpy as jnp
+import pytest
 import numpy as np
 
 from tpu_ddp.models.vit import ViT, full_attention
@@ -55,6 +56,7 @@ def test_vit_forward_and_registry(devices):
     assert bool(jnp.all(jnp.isfinite(logits)))
 
 
+@pytest.mark.slow  # ~30s: make test-all
 def test_resnet_family_forward(devices):
     from tpu_ddp.models import MODEL_REGISTRY
 

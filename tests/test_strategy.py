@@ -10,6 +10,8 @@ exceeding the reference's DP-only surface (``/root/reference/main.py:60-63``).
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-process / e2e-CLI / AOT: make test-all
+
 import jax
 
 from tpu_ddp.train.strategy import (

@@ -217,3 +217,105 @@ def test_fsdp_tp_step_matches_unsharded_math(devices):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
         )
+
+
+# ------------------------------------------------------ conv-model TP --
+# Round-3 verdict item 4: the reference's OWN model family
+# (/root/reference/model/resnet.py:5-22) must not be locked out of TP.
+# CNN_TP_RULES channel-shard every conv kernel (HWIO: O over `model`), BN
+# params with their channels, and close the dense head Megatron-style.
+
+def test_cnn_tp_step_matches_unsharded_math(devices):
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.parallel.tensor_parallel import CNN_TP_RULES
+
+    mesh = create_mesh(MeshSpec(data=2, model=4), devices)
+    model = NetResDeep()  # 32 channels: divisible by model=4
+    tx = make_optimizer(lr=0.01, momentum=0.9)
+    state = create_train_state(model, tx, jax.random.key(0))
+    batch = _batch(16)
+
+    # unsharded global-batch reference (train-mode BN, stats mutable)
+    logits, _ = model.apply(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        jnp.asarray(batch["image"]), train=True, mutable=["batch_stats"],
+    )
+    ref_loss = float(cross_entropy_loss(
+        logits, jnp.asarray(batch["label"]), jnp.asarray(batch["mask"])
+    ))
+
+    step, shardings = make_tp_train_step(
+        model, tx, mesh, state, rules=CNN_TP_RULES, has_batch_stats=True
+    )
+    sharded = shard_train_state(state, shardings)
+    new_state, metrics = step(sharded, batch)
+    assert abs(float(metrics["loss"]) - ref_loss) < 5e-4
+
+    # conv kernel physically out-channel-sharded; BN params follow
+    k = new_state.params["resblock"]["conv"]["kernel"]
+    assert k.sharding.spec == P(None, None, None, "model")
+    assert k.addressable_shards[0].data.shape == (3, 3, 32, 8)
+    assert (
+        new_state.params["resblock"]["batch_norm"]["scale"].sharding.spec
+        == P("model")
+    )
+    # head pair: fc1 column-sharded, fc2 row-sharded
+    assert new_state.params["fc1"]["kernel"].sharding.spec == P(None, "model")
+    assert new_state.params["fc2"]["kernel"].sharding.spec == P("model", None)
+
+    # second (donation-path) step stays finite
+    _, m2 = step(new_state, _batch(16, seed=1))
+    assert np.isfinite(float(m2["loss"]))
+
+
+def test_cnn_tp_resnet_family_rules(devices):
+    """The auto-named flax paths of resnet_family (Conv_0, BatchNorm_0,
+    stem_conv, head) all match CNN_TP_RULES, and a resnet18 TP step
+    reproduces the unsharded math."""
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+    from tpu_ddp.parallel.tensor_parallel import CNN_TP_RULES
+
+    mesh = create_mesh(MeshSpec(data=2, model=4), devices)
+    model = MODEL_REGISTRY["resnet18"](num_classes=10)
+    tx = make_optimizer(lr=0.01, momentum=0.9)
+    state = create_train_state(model, tx, jax.random.key(1))
+    batch = _batch(16, seed=3)
+
+    specs = specs_for_params(state.params, CNN_TP_RULES)
+    assert specs["stem_conv"]["kernel"] == P(None, None, None, "model")
+    assert specs["_BasicBlock_0"]["Conv_0"]["kernel"] == P(
+        None, None, None, "model"
+    )
+    assert specs["_BasicBlock_0"]["BatchNorm_0"]["scale"] == P("model")
+    assert specs["head"]["kernel"] == P("model", None)
+
+    logits, _ = model.apply(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        jnp.asarray(batch["image"]), train=True, mutable=["batch_stats"],
+    )
+    ref_loss = float(cross_entropy_loss(
+        logits, jnp.asarray(batch["label"]), jnp.asarray(batch["mask"])
+    ))
+    step, shardings = make_tp_train_step(
+        model, tx, mesh, state, rules=CNN_TP_RULES, has_batch_stats=True
+    )
+    _, metrics = step(shard_train_state(state, shardings), batch)
+    assert abs(float(metrics["loss"]) - ref_loss) < 5e-4
+
+
+def test_cnn_tp_via_strategy_router(devices):
+    """build_strategy('tp') accepts the conv family now (was a ValueError
+    through round 3) and its eval step agrees with the training layout."""
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.train.strategy import build_strategy
+
+    mesh = create_mesh(MeshSpec(data=2, model=4), devices)
+    model = NetResDeep(n_chans1=8, n_blocks=2)
+    tx = make_optimizer(lr=0.01, momentum=0.9)
+    strat = build_strategy("tp", mesh, model, tx, jax.random.key(0))
+    batch = _batch(16, seed=5)
+    new_state, metrics = strat.train_step(strat.state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    ev = strat.eval_step(strat.prepare_eval(new_state), batch)
+    assert float(ev["count"]) == 16.0
+    assert np.isfinite(float(ev["loss_sum"]))

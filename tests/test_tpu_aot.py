@@ -13,6 +13,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-process / e2e-CLI / AOT: make test-all
+
 
 @pytest.fixture(scope="module")
 def v5e_topo():
@@ -108,3 +110,45 @@ def test_memplan_fsdp_scatters_state(v5e_topo):
     # well under: state dominates this config, and it scatters 4 ways
     assert (fs["per_device"]["argument_bytes"]
             < 0.6 * dp["per_device"]["argument_bytes"])
+
+
+@pytest.mark.parametrize(
+    "model,parallelism,axis_size",
+    [
+        ("netresdeep", "tp", 4),      # conv channel-sharding rules
+        ("netresdeep", "fsdp_tp", 4),
+        ("vit_s4", "pp", 2),          # GPipe stage-major layout
+        ("vit_moe_s4", "ep", 4),      # expert scatter + token all-to-all
+    ],
+)
+def test_memplan_sharded_layouts(v5e_topo, model, parallelism, axis_size):
+    """Round-3 verdict item 6: the HBM planner covers the TP/PP/EP layouts
+    with the same compiler-ground-truth method as dp/fsdp — each plan
+    compiles the REAL sharded train step for a v5e:2x2 slice and returns a
+    fit verdict."""
+    from tpu_ddp.tools.memplan import plan
+
+    report = plan(
+        model, 8, compute_dtype="float32", remat=False,
+        topology="v5e:2x2", n_devices=None, parallelism=parallelism,
+        axis_size=axis_size,
+    )
+    assert report["parallelism"] == parallelism
+    assert report["device_kind"] == "TPU v5 lite"
+    assert report["per_device"]["argument_bytes"] > 0
+    assert report["fits"] is True
+
+
+def test_memplan_rejects_bad_combos(v5e_topo):
+    from tpu_ddp.tools.memplan import plan
+
+    with pytest.raises(ValueError, match="pp plans the GPipe"):
+        plan("netresdeep", 8, compute_dtype="float32", remat=False,
+             topology="v5e:2x2", n_devices=None, parallelism="pp")
+    with pytest.raises(ValueError, match="must divide"):
+        plan("vit_s4", 8, compute_dtype="float32", remat=False,
+             topology="v5e:2x2", n_devices=None, parallelism="pp",
+             axis_size=4)  # vit_s4 depth 6 % 4 != 0
+    with pytest.raises(ValueError, match="ep plans the expert-parallel"):
+        plan("resnet18", 8, compute_dtype="float32", remat=False,
+             topology="v5e:2x2", n_devices=None, parallelism="ep")

@@ -8,6 +8,7 @@ same data must reach the same math.
 """
 
 import jax
+import pytest
 import numpy as np
 
 from tpu_ddp.train.trainer import TrainConfig, Trainer
@@ -36,6 +37,7 @@ def test_prefetched_epoch_matches_direct(devices):
     np.testing.assert_array_equal(direct, prefetched)
 
 
+@pytest.mark.slow  # ~30-55s each: make test-all
 def test_prefetched_fused_scan_matches_direct(devices):
     """Fused K-step groups assembled as ONE native gather (concatenated
     indices) == K separate gathers stacked on host."""
@@ -44,6 +46,7 @@ def test_prefetched_fused_scan_matches_direct(devices):
     np.testing.assert_array_equal(direct, prefetched)
 
 
+@pytest.mark.slow  # ~30-55s each: make test-all
 def test_fused_scan_matches_single_steps(devices):
     """steps_per_call must be a pure dispatch optimization."""
     single = _run(prefetch_depth=0)
@@ -51,6 +54,7 @@ def test_fused_scan_matches_single_steps(devices):
     np.testing.assert_allclose(single, fused, rtol=1e-6)
 
 
+@pytest.mark.slow  # ~30-55s each: make test-all
 def test_resume_continues_identically(devices, tmp_path):
     """Checkpoint at epoch 2 then resume for epochs 3-4 must reproduce the
     uninterrupted 4-epoch run's loss trajectory exactly (state + data order

@@ -22,6 +22,7 @@ def plot_loss_curves(
     out_path: str,
     *,
     xlabel: str = "epoch",
+    ylabel: str = "loss",
     title: str = "training curves",
 ) -> str:
     """series: name -> values (e.g. {'train_loss': [...], 'val_loss': [...]})."""
@@ -29,7 +30,7 @@ def plot_loss_curves(
     for name, values in series.items():
         ax.plot(range(1, len(values) + 1), values, label=name)
     ax.set_xlabel(xlabel)
-    ax.set_ylabel("loss")
+    ax.set_ylabel(ylabel)
     ax.set_title(title)
     ax.legend()
     fig.tight_layout()

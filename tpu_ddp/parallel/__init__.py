@@ -38,6 +38,7 @@ from tpu_ddp.parallel.partitioning import (
 # and no import cycle forms through tpu_ddp.train.
 _LAZY = {
     "VIT_TP_RULES": "tensor_parallel",
+    "CNN_TP_RULES": "tensor_parallel",
     "make_fsdp_train_step": "tensor_parallel",
     "make_sharded_train_step": "tensor_parallel",
     "make_tp_train_step": "tensor_parallel",
@@ -82,6 +83,7 @@ __all__ = [
     "specs_for_params",
     "train_state_shardings",
     "VIT_TP_RULES",
+    "CNN_TP_RULES",
     "make_fsdp_train_step",
     "make_sharded_train_step",
     "make_tp_train_step",

@@ -50,6 +50,34 @@ VIT_TP_RULES = (
     PartitionRule(r"mlp_down/kernel$", P(MODEL_AXIS, None)),
 )
 
+# Channel-sharding layout for the conv families (models/resnet.py
+# NetResDeep — the reference's own flagship, /root/reference/model/
+# resnet.py:5-22 — and models/resnet_family.py ResNet-18..152): every conv
+# kernel is OUT-channel-sharded (flax Conv kernels are HWIO, so the last
+# dim), which keeps activations channel-sharded through the
+# conv->BN->relu(+residual) chains — BatchNorm is per-channel, so its
+# scale/bias shard the same way and nothing in a block needs a gather.
+# XLA closes each conv's in-channel contraction with the collective GSPMD
+# picks (the scaling-book recipe: annotate, let the partitioner insert).
+# The dense head closes Megatron-style: first fc column-sharded, final
+# classifier row-sharded with the class dim replicated.
+CNN_TP_RULES = (
+    # conv kernels under any flax naming in-tree: conv1, conv, Conv_0,
+    # stem_conv (HWIO: shard O)
+    PartitionRule(r"(conv[^/]*|Conv_\d+)/kernel$",
+                  P(None, None, None, MODEL_AXIS)),
+    PartitionRule(r"(conv[^/]*|Conv_\d+)/bias$", P(MODEL_AXIS)),
+    # BN params follow the channel-sharded activations they normalize
+    PartitionRule(r"(batch_norm|BatchNorm_\d+|stem_bn)/(scale|bias)$",
+                  P(MODEL_AXIS)),
+    # NetResDeep head pair (fc1 -> relu -> fc2)
+    PartitionRule(r"fc1/kernel$", P(None, MODEL_AXIS)),
+    PartitionRule(r"fc1/bias$", P(MODEL_AXIS)),
+    PartitionRule(r"fc2/kernel$", P(MODEL_AXIS, None)),
+    # ResNet family classifier: input is the pooled (sharded) channel dim
+    PartitionRule(r"head/kernel$", P(MODEL_AXIS, None)),
+)
+
 
 def make_sharded_train_step(
     model,
@@ -143,15 +171,18 @@ def make_tp_train_step(
     data_axis: str = DATA_AXIS,
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
+    has_batch_stats: bool = False,
     aux_weight: float = 0.01,
 ):
-    """Tensor-parallel (optionally DP x TP on a 2-D mesh) ViT train step.
+    """Tensor-parallel (optionally DP x TP on a 2-D mesh) train step; pass
+    ``rules=CNN_TP_RULES`` + ``has_batch_stats=True`` for the conv families.
 
     Returns (step, state_shardings)."""
     param_specs = specs_for_params(state_template.params, rules)
     build = make_sharded_train_step(
         model, tx, mesh, param_specs,
         data_axis=data_axis, loss_fn=loss_fn, donate=donate,
+        has_batch_stats=has_batch_stats,
         aux_weight=aux_weight,
     )
     return build(state_template)
@@ -167,6 +198,7 @@ def make_fsdp_tp_train_step(
     data_axis: str = DATA_AXIS,
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
+    has_batch_stats: bool = False,
     aux_weight: float = 0.01,
 ):
     """2-D FSDP x TP on a ``data x model`` mesh — the scaling-book layout:
@@ -183,6 +215,7 @@ def make_fsdp_tp_train_step(
     build = make_sharded_train_step(
         model, tx, mesh, param_specs,
         data_axis=data_axis, loss_fn=loss_fn, donate=donate,
+        has_batch_stats=has_batch_stats,
         aux_weight=aux_weight,
     )
     return build(state_template)

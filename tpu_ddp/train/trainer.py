@@ -779,11 +779,16 @@ class Trainer:
                 if step_losses
                 else float("nan")
             )
+            trace_dump_seconds = 0.0
             if epoch == profile_epoch:
                 # the device_get above already fenced the epoch's dispatches;
                 # stopping here (before the preempt check) covers both the
                 # normal path and a drain during the profiled epoch
+                trace_t0 = time.perf_counter()
                 jax.profiler.stop_trace()
+                # the trace dump is host IO, not training — keep it out of
+                # the steady-state throughput window below
+                trace_dump_seconds = time.perf_counter() - trace_t0
                 self.logger.log_text(f"profiler trace -> {c.profile_dir}")
             if self._preempt_agreed():
                 self.logger.log_text(
@@ -796,7 +801,9 @@ class Trainer:
                 last_metrics["preempted"] = True
                 break  # the tail below writes the final checkpoint
             if epoch > start_epoch + 1:  # device_get above = a sync boundary
-                steady_seconds += time.perf_counter() - epoch_t0
+                steady_seconds += (
+                    time.perf_counter() - epoch_t0 - trace_dump_seconds
+                )
                 steady_steps += n_steps
             self.history["epoch"].append(epoch)
             self.history["train_loss"].append(mean_loss)
