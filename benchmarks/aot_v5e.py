@@ -227,6 +227,32 @@ def main() -> None:
 
     progs["tp_vit_2x4"] = _compile("tp_vit_2x4", tp_compile)
 
+    # 4b. Channel-sharded conv TP on the reference's own model family
+    # (CNN_TP_RULES; mirrors the TP_CNN dryrun leg) — proves the conv
+    # layout's collectives lower for the real v5e target too.
+    def tp_cnn_compile():
+        from tpu_ddp.parallel.tensor_parallel import CNN_TP_RULES
+
+        devs = np.asarray(topo.devices).reshape(2, 4)
+        tp_mesh = Mesh(devs, ("data", "model"))
+        cnn = NetResDeep()
+        ctx = make_optimizer(lr=1e-2, momentum=0.9)
+        cstate = jax.eval_shape(
+            lambda: create_train_state(cnn, ctx, jax.random.key(0))
+        )
+        cstep, _sh = make_tp_train_step(
+            cnn, ctx, tp_mesh, cstate,
+            rules=CNN_TP_RULES, has_batch_stats=True,
+        )
+        cbs = jax.sharding.NamedSharding(
+            tp_mesh, jax.sharding.PartitionSpec("data")
+        )
+        return cstep.trace(cstate, batch_for(64, cbs)).lower().compile()
+
+    progs["tp_cnn_netresdeep_2x4"] = _compile(
+        "tp_cnn_netresdeep_2x4", tp_cnn_compile
+    )
+
     # 5-8. The remaining parallel families, mirroring the dryrun legs
     # (__graft_entry__) in compile-only form. States are abstractified
     # (ShapeDtypeStruct + the builder's shardings) — compile-only devices
@@ -332,6 +358,40 @@ def main() -> None:
 
     progs["sp_ring_attention_4x2"] = _compile(
         "sp_ring_attention_4x2", sp_compile
+    )
+
+    # 8b. LONG-CONTEXT ring attention at scale: 16,384 tokens sharded 8
+    # ways (2,048 tokens/device), bf16, forward AND backward. Full
+    # attention would materialize a 16k x 16k score matrix (1 GiB in f32
+    # PER HEAD — 8 GiB for this program's 8 heads); the ring holds only a
+    # 2k x 2k tile per step while
+    # K/V rotate over ICI (collective-permute in the HLO below). This is
+    # the brief's "long sequences are first-class" claim in compiled form.
+    def long_ctx_compile():
+        from tpu_ddp.parallel.ring_attention import (
+            sequence_sharded_attention,
+        )
+
+        m1 = Mesh(np.asarray(topo.devices).reshape(1, 8),
+                  ("data", "sequence"))
+        attn = sequence_sharded_attention(m1)
+        T, H, D = 16384, 8, 128
+        seq_sh = NamedSharding(m1, P(None, "sequence"))
+        qs = jax.ShapeDtypeStruct((1, T, H, D), jnp.bfloat16,
+                                  sharding=seq_sh)
+
+        def fwd_and_grad(q, k, v):
+            out = attn(q, k, v)
+            # a training path: grad of a scalar loss through the ring
+            g = jax.grad(
+                lambda a: attn(a, k, v).astype(jnp.float32).sum()
+            )(q)
+            return out, g
+
+        return jax.jit(fwd_and_grad).trace(qs, qs, qs).lower().compile()
+
+    progs["ring_attention_16k_x8"] = _compile(
+        "ring_attention_16k_x8", long_ctx_compile
     )
 
     # 9. Pod-scale sweep: the same SPMD programs compiled for full v5e

@@ -17,10 +17,12 @@ averaged over seeds:
   is global batch 256 — the batch the reference's own config lands on
   when scaled to 8 workers.
 - **framework** — the knobs this framework adds, tuned as a large-batch
-  recipe: cross-replica sync-BN (``--sync-bn``), momentum 0.9 with the
-  classically rescaled lr 5e-3 (momentum multiplies the effective step
-  ~1/(1-m); keeping the reference's lr with momentum diverges — we
-  measured it), and weight decay 5e-4. ``--fw-flags``/``--fw-lr`` to
+  recipe: cross-replica sync-BN (``--sync-bn``), momentum 0.9 at a
+  halved, tuned lr of 5e-3 (momentum multiplies the effective step
+  ~1/(1-m), so the reference's lr must come DOWN with momentum: at the
+  unscaled 1e-2 the momentum arm plateaus ~0.11 lower at this budget and
+  diverges outright at smaller per-shard batches — both measured), and
+  weight decay 5e-4. ``--fw-flags``/``--fw-lr`` to
   change; ``--tpu-dtypes`` adds bfloat16 on MXU hardware. Cosine decay
   and on-device augmentation are implemented but excluded here: both
   measured WORSE on this task at this budget (augmentation destroys the
@@ -67,6 +69,9 @@ def run_recipe(name: str, extra: list, args, seed: int) -> dict:
     from tpu_ddp.cli.train import main
 
     jsonl = os.path.join(args.out_dir, f"{name}_seed{seed}.jsonl")
+    if os.path.exists(jsonl):
+        os.unlink(jsonl)  # MetricLogger appends; a rerun over a committed
+        # artifact must not concatenate two experiments into one curve
     argv = [
         "--device", args.device,
         "--synthetic-data",

@@ -156,6 +156,33 @@ def test_fsdp_predict_roundtrip(devices):
 
 
 def test_strategy_rejects_wrong_model(devices):
+    """pp/ep still gate on the family their layouts require (tp no longer
+    does: CNN_TP_RULES cover the conv families since round 4)."""
+    from tpu_ddp.train.trainer import TrainConfig, Trainer
+
+    config = TrainConfig(
+        synthetic_data=True, synthetic_size=64, epochs=1,
+        per_shard_batch=8, model="netresdeep",
+        mesh={"data": 2, "pipeline": 4},
+    )
+    with pytest.raises(ValueError, match="vit"):
+        Trainer(config)
+
+    config = TrainConfig(
+        synthetic_data=True, synthetic_size=64, epochs=1,
+        per_shard_batch=8, model="resnet18",
+        mesh={"data": 2, "expert": 4},
+    )
+    with pytest.raises(ValueError, match="MoE"):
+        Trainer(config)
+
+
+def test_strategy_tp_accepts_reference_model(devices):
+    """The round-3 gate (`--parallelism tp` raising for the reference's own
+    model family) is gone: a netresdeep TP Trainer builds and its state is
+    laid out over the model axis."""
+    from jax.sharding import PartitionSpec as P
+
     from tpu_ddp.train.trainer import TrainConfig, Trainer
 
     config = TrainConfig(
@@ -163,8 +190,11 @@ def test_strategy_rejects_wrong_model(devices):
         per_shard_batch=8, model="netresdeep",
         mesh={"data": 2, "model": 4},
     )
-    with pytest.raises(ValueError, match="vit"):
-        Trainer(config)
+    t = Trainer(config)
+    assert t.parallelism == "tp"
+    spec = t.state.params["resblock"]["conv"]["kernel"].sharding.spec
+    assert spec == P(None, None, None, "model")
+    t.close()
 
 
 def test_strategy_rejects_augment(devices):

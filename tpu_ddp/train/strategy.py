@@ -288,6 +288,21 @@ def build_strategy(
         )
 
     if parallelism == "pp":
+        # ViT-only BY DESIGN (round-4 decision, measured): the GPipe
+        # schedule stacks stages into one lax.scan, which requires every
+        # stage to share a single (param-shapes, activation-shape)
+        # signature — true for a transformer's homogeneous blocks, false
+        # for conv ResNets, whose stages change channel width AND spatial
+        # extent (resnet_family.py stage loop). A heterogeneous-stage
+        # pipeline would need per-stage programs (serializing compilation
+        # and defeating the scan fusion). And the conv family does not
+        # need PP on this hardware: the LARGEST conv model in the zoo
+        # (ResNet-152, bf16, per-shard batch 256) plans at 6.4 GB peak —
+        # 40% of one v5e chip's 16 GB HBM (`tpu-ddp-memplan --model
+        # resnet152 --compute-dtype bfloat16 --batch-size 256
+        # --n-devices 1`, compiler memory analysis), so memory never
+        # forces conv layers apart; scale conv models with dp/fsdp/tp
+        # instead (all three work for them).
         _require_model(model, ("vit",), "pp")
         from tpu_ddp.parallel.pipeline import (
             create_pp_train_state,
