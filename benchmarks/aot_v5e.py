@@ -360,32 +360,39 @@ def main() -> None:
         "sp_ring_attention_4x2", sp_compile
     )
 
-    # 8b. LONG-CONTEXT ring attention at scale: 16,384 tokens sharded 8
-    # ways (2,048 tokens/device), bf16, forward AND backward. Full
-    # attention would materialize a 16k x 16k score matrix (1 GiB in f32
-    # PER HEAD — 8 GiB for this program's 8 heads); the ring holds only a
-    # 2k x 2k tile per step while
+    # 8b. LONG-CONTEXT flash-ring attention at scale: 16,384 tokens
+    # sharded 8 ways (2,048 tokens/device), bf16, forward AND backward,
+    # with the Pallas flash kernel as the per-block tile (Mosaic
+    # custom-calls in the HLO). Full attention would materialize a
+    # 16k x 16k score matrix (1 GiB in f32 PER HEAD — 8 GiB for this
+    # program's 8 heads); the ring keeps VMEM-resident tiles while
     # K/V rotate over ICI (collective-permute in the HLO below). This is
     # the brief's "long sequences are first-class" claim in compiled form.
     def long_ctx_compile():
-        from tpu_ddp.parallel.ring_attention import (
-            sequence_sharded_attention,
-        )
+        from tpu_ddp.parallel.ring_attention import ring_flash_attention
 
         m1 = Mesh(np.asarray(topo.devices).reshape(1, 8),
                   ("data", "sequence"))
-        attn = sequence_sharded_attention(m1)
         T, H, D = 16384, 8, 128
-        seq_sh = NamedSharding(m1, P(None, "sequence"))
+        spec = P(None, "sequence")
+        seq_sh = NamedSharding(m1, spec)
         qs = jax.ShapeDtypeStruct((1, T, H, D), jnp.bfloat16,
                                   sharding=seq_sh)
+        ring = jax.shard_map(
+            lambda a, b, c: ring_flash_attention(a, b, c, "sequence"),
+            mesh=m1, in_specs=(spec, spec, spec), out_specs=spec,
+        )
 
         def fwd_and_grad(q, k, v):
-            out = attn(q, k, v)
-            # a training path: grad of a scalar loss through the ring
+            out = ring(q, k, v)
+            # a training path through BOTH ring passes: grads wrt q, k
+            # AND v, so the backward's rotating dk/dv accumulator chain
+            # is live in the compiled program (grad wrt q alone lets XLA
+            # DCE the second ring)
             g = jax.grad(
-                lambda a: attn(a, k, v).astype(jnp.float32).sum()
-            )(q)
+                lambda a, b, c: ring(a, b, c).astype(jnp.float32).sum(),
+                (0, 1, 2),
+            )(q, k, v)
             return out, g
 
         return jax.jit(fwd_and_grad).trace(qs, qs, qs).lower().compile()

@@ -78,6 +78,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="default: derived from --dataset (cifar10=10, "
                         "cifar100=100)")
     p.add_argument("--sync-bn", action="store_true")
+    p.add_argument("--sp-flash", action="store_true",
+                   help="sequence-parallel runs with Pallas flash-kernel "
+                        "ring-attention blocks (long-context config; "
+                        "falls back to the fused-jnp tile off-TPU)")
     p.add_argument("--compute-dtype", choices=["float32", "bfloat16"],
                    default="float32",
                    help="bfloat16 runs the forward/backward on the MXU at "
@@ -240,6 +244,7 @@ def config_from_args(args) -> TrainConfig:
         reshuffle_each_epoch=not args.faithful_epoch_order,
         augment=args.augment,
         sync_bn=args.sync_bn,
+        sp_flash=args.sp_flash,
         compute_dtype=args.compute_dtype,
         remat=args.remat,
         model=args.model,

@@ -98,6 +98,10 @@ class ViT(nn.Module):
     mlp_ratio: int = 4
     attention_impl: Callable = staticmethod(full_attention)
     sp_axis: Optional[str] = None
+    # SP only: per-ring-block attention runs the Pallas flash kernel
+    # (VMEM tiles) instead of the fused-jnp score tile — the long-context
+    # configuration (parallel/ring_attention.py::ring_flash_attention)
+    sp_flash: bool = False
     dtype: jnp.dtype = jnp.float32
     # kept for CLI/model-zoo interface parity with the CNNs; ViT has no BN
     bn_cross_replica_axis: Optional[str] = None
@@ -120,7 +124,10 @@ class ViT(nn.Module):
         if self.sp_axis is not None:
             import functools
 
-            from tpu_ddp.parallel.ring_attention import ring_attention
+            from tpu_ddp.parallel.ring_attention import (
+                ring_attention,
+                ring_flash_attention,
+            )
 
             n_shards = lax.axis_size(self.sp_axis)
             pos = self.param(
@@ -133,7 +140,8 @@ class ViT(nn.Module):
             start = lax.axis_index(self.sp_axis) * t_local
             pos = lax.dynamic_slice_in_dim(pos, start, t_local, axis=1)
             attention_impl = functools.partial(
-                ring_attention, axis_name=self.sp_axis
+                ring_flash_attention if self.sp_flash else ring_attention,
+                axis_name=self.sp_axis,
             )
         else:
             pos = self.param(

@@ -22,6 +22,8 @@ tests/test_ring_attention.py.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -62,6 +64,177 @@ def ring_attention(q, k, v, *, axis_name: str):
         m = m_new
     out = o / l[..., None]  # (B,H,Tq,D)
     return out.transpose(0, 2, 1, 3)  # -> (B, Tq, H, D)
+
+
+# ------------------------------------------------------ flash ring --
+# Ring attention with the Pallas flash kernel as the per-block tile
+# (Ring Attention = blockwise flash attention with the KV blocks living
+# on other devices). The jnp ring above materializes a full
+# (B,H,T_local,T_local) score tile per step in f32; the flash version
+# keeps tiles in VMEM at (block_q x block_k), so T_local scales to the
+# long-context regime. Exactness is unchanged — same online-softmax
+# math, pinned against full attention by tests/test_ring_attention.py.
+#
+# Gradients: the flash backward kernels consume the GLOBAL (out, lse,
+# di=rowsum(g*out)) and a KV block, which is exactly the blockwise
+# decomposition of full-attention's backward — so the backward is a
+# second ring pass: dq accumulates locally while (k, v, dk, dv) rotate
+# together; after n hops the dk/dv accumulators arrive back at their
+# owning device complete.
+
+def _canon_lse(lse_folded, B, H, T):
+    # kernel layout (B*H, T, LANE) lane-broadcast -> canonical (B, H, T)
+    return lse_folded[:, :, 0].reshape(B, H, T)
+
+
+def _fold_lse(lse):
+    from tpu_ddp.ops.flash_attention import LANE
+
+    B, H, T = lse.shape
+    return jnp.broadcast_to(
+        lse.reshape(B * H, T, 1), (B * H, T, LANE)
+    ).astype(jnp.float32)
+
+
+def _use_kernels(q, block_q, block_k, interpret) -> bool:
+    from tpu_ddp.ops.flash_attention import _plan, _resolve_interpret
+
+    interp = _resolve_interpret(interpret)
+    if _plan(q.shape, block_q, block_k) is None:
+        return False
+    # interpret-mode pallas under shard_map trips the hlo-interpreter vma
+    # check (see ops/flash_attention.py::_flash_forward) — jnp path there
+    if interp and bool(getattr(jax.typeof(q), "vma", None)):
+        return False
+    return True
+
+
+def _block_fwd(q, k, v, scale, use_kernels, block_q, block_k, interpret):
+    """(o_normalized f32 (B,T,H,D), lse (B,H,T)) for one KV block."""
+    B, T, H, D = q.shape
+    if use_kernels:
+        from tpu_ddp.ops.flash_attention import (
+            _flash_forward,
+            _resolve_interpret,
+        )
+
+        o, lse_f = _flash_forward(
+            q, k, v, block_q=block_q, block_k=block_k,
+            interpret=_resolve_interpret(interpret),
+        )
+        return o.astype(jnp.float32), _canon_lse(lse_f, B, H, T)
+    o_u, m, l = _block(q, k, v, scale)  # unnormalized, (B,H,T,D)/(B,H,T)
+    o = (o_u / l[..., None]).transpose(0, 2, 1, 3)  # -> (B,T,H,D)
+    return o.astype(jnp.float32), m + jnp.log(l)
+
+
+def _combine(o, lse, o2, lse2):
+    """Merge two normalized blocks: o in (B,T,H,D) f32, lse in (B,H,T)."""
+    lse_new = jnp.logaddexp(lse, lse2)
+    w1 = jnp.exp(lse - lse_new).transpose(0, 2, 1)[..., None]  # (B,T,H,1)
+    w2 = jnp.exp(lse2 - lse_new).transpose(0, 2, 1)[..., None]
+    return o * w1 + o2 * w2, lse_new
+
+
+def _ring_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret):
+    n = lax.axis_size(axis_name)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    use_k = _use_kernels(q, block_q, block_k, interpret)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    o, lse = _block_fwd(q, k, v, scale, use_k, block_q, block_k, interpret)
+    for _ in range(n - 1):
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        o2, lse2 = _block_fwd(q, k, v, scale, use_k, block_q, block_k,
+                              interpret)
+        o, lse = _combine(o, lse, o2, lse2)
+    return o.astype(q.dtype), lse
+
+
+def _block_bwd(q, k, v, out, lse, g, scale, use_kernels, block_q, block_k,
+               interpret):
+    """(dq, dk, dv) contribution of ONE KV block to the global attention
+    backward; ``out``/``lse`` are the COMBINED forward results."""
+    if use_kernels:
+        from tpu_ddp.ops.flash_attention import (
+            _flash_backward,
+            _resolve_interpret,
+        )
+
+        return _flash_backward(
+            q, k, v, out, _fold_lse(lse), g,
+            block_q=block_q, block_k=block_k,
+            interpret=_resolve_interpret(interpret),
+        )
+    # jnp fallback: p = exp(s - lse_total); ds = p * (dP - di) * scale
+    f32 = jnp.float32
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(f32), k.astype(f32)) * scale
+    p = jnp.exp(s - lse[..., None])                       # (B,H,Tq,Tk)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, g.astype(f32))
+    dp = jnp.einsum("bqhd,bkhd->bhqk", g.astype(f32), v.astype(f32))
+    di = jnp.sum(g.astype(f32) * out.astype(f32), axis=-1)  # (B,Tq,H)
+    ds = p * (dp - di.transpose(0, 2, 1)[..., None]) * scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(f32))
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(f32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name: str, block_q: int, block_k: int,
+                interpret: bool | None):
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, block_q, block_k,
+                            interpret)
+    return out
+
+
+def _rf_fwd(q, k, v, axis_name, block_q, block_k, interpret):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, block_q, block_k,
+                              interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _rf_bwd(axis_name, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis_name)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    use_k = _use_kernels(q, block_q, block_k, interpret)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    f32 = jnp.float32
+    dq = jnp.zeros(q.shape, f32)
+    dk = jnp.zeros(k.shape, f32)
+    dv = jnp.zeros(v.shape, f32)
+    for i in range(n):
+        dq_b, dk_b, dv_b = _block_bwd(
+            q, k, v, out, lse, g, scale, use_k, block_q, block_k, interpret
+        )
+        dq = dq + dq_b.astype(f32)
+        dk = dk + dk_b.astype(f32)
+        dv = dv + dv_b.astype(f32)
+        # rotate the KV blocks AND their gradient accumulators together:
+        # after the remaining hops they arrive home complete. (The final
+        # iteration's k/v rotation is dead code XLA drops.)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_rf_fwd, _rf_bwd)
+
+
+def ring_flash_attention(q, k, v, axis_name: str, block_q: int = 128,
+                         block_k: int = 128,
+                         interpret: bool | None = None):
+    """Ring attention with Pallas flash tiles. Same contract as
+    ``ring_attention`` (q,k,v: (B, T_local, H, D) sequence-sharded over
+    ``axis_name``; exact non-causal attention over the global sequence);
+    falls back to the fused-jnp tile when the shapes don't fit the kernel
+    planner or under interpret-mode shard_map. Keyword-friendly wrapper:
+    custom_vjp nondiff_argnums require positional passing internally."""
+    return _ring_flash(q, k, v, axis_name, block_q, block_k, interpret)
 
 
 def sequence_sharded_attention(mesh, axis_name: str = "sequence"):

@@ -242,6 +242,7 @@ def build_strategy(
     compute_accuracy: bool = True,
     aux_weight: float = 0.01,
     n_microbatches: int = 2,
+    sp_flash: bool = False,
     initial_state: Optional[TrainState] = None,
 ) -> Strategy:
     """Build the full strategy for any non-dp mode on a prebuilt mesh. (The
@@ -263,7 +264,9 @@ def build_strategy(
         _require_model(model, ("vit",), "sp")
         from tpu_ddp.parallel.sequence_parallel import make_sp_train_step
 
-        sp_model = model.clone(sp_axis=SEQUENCE_AXIS)
+        # sp_flash: Pallas flash tiles inside each ring block (the
+        # long-context configuration); param shapes are unchanged
+        sp_model = model.clone(sp_axis=SEQUENCE_AXIS, sp_flash=sp_flash)
         plain = model.clone(sp_axis=None)
         # Init through the PLAIN module: the SP module needs a live mesh
         # axis even to trace (ring position indexing), but its param shapes

@@ -65,6 +65,7 @@ class TrainConfig:
     augment: bool = False                 # on-device random crop+flip
                                           # (reference has none; SURVEY §7.3)
     sync_bn: bool = False
+    sp_flash: bool = False               # SP: flash-kernel ring blocks
     compute_dtype: str = "float32"        # float32 | bfloat16 (MXU 2x)
     steps_per_call: int = 1               # >1: fuse K optimizer steps into
                                           # one dispatch (lax.scan) — hides
@@ -411,6 +412,7 @@ class Trainer:
             compute_accuracy=with_acc,
             aux_weight=config.aux_weight,
             n_microbatches=config.n_microbatches,
+            sp_flash=config.sp_flash,
             initial_state=initial,
         )
         self.state = strategy.state
