@@ -378,8 +378,15 @@ def main() -> None:
         seq_sh = NamedSharding(m1, spec)
         qs = jax.ShapeDtypeStruct((1, T, H, D), jnp.bfloat16,
                                   sharding=seq_sh)
+        # interpret=False explicitly: this process's default backend is
+        # CPU, so the None-default would resolve to interpret mode and
+        # the ring would silently compile the fused-jnp tile fallback
+        # instead of the Mosaic kernels (caught by checking
+        # custom_call_target: jnp path = zero tpu_custom_calls)
         ring = jax.shard_map(
-            lambda a, b, c: ring_flash_attention(a, b, c, "sequence"),
+            lambda a, b, c: ring_flash_attention(
+                a, b, c, "sequence", 128, 128, False
+            ),
             mesh=m1, in_specs=(spec, spec, spec), out_specs=spec,
         )
 
@@ -399,6 +406,47 @@ def main() -> None:
 
     progs["ring_attention_16k_x8"] = _compile(
         "ring_attention_16k_x8", long_ctx_compile
+    )
+
+    # 8c. POD-SCALE long context: 131,072 tokens ring-sharded 64 ways
+    # (2,048/device) x 4-way data parallel on the full v5e-256 pod, bf16,
+    # forward AND backward wrt q/k/v. Above _UNROLL_MAX the ring rolls
+    # into ONE lax.scan body, so the HLO stays small and compiles in
+    # seconds regardless of ring size (see compile_wall_s in the
+    # committed json) — full attention at this length would materialize
+    # ~2.2 TB of f32 scores (4 x 8 x 131072^2 x 4 B); the ring's working
+    # set is scan-carried flash tiles.
+    def pod_ring_compile():
+        from tpu_ddp.parallel.ring_attention import ring_flash_attention
+
+        ptopo = topologies.get_topology_desc("v5e:16x16", "tpu")
+        pmesh = Mesh(np.asarray(ptopo.devices).reshape(4, 64),
+                     ("data", "sequence"))
+        T, H, D = 64 * 2048, 8, 128
+        spec = P("data", "sequence")
+        qs = jax.ShapeDtypeStruct(
+            (4, T, H, D), jnp.bfloat16,
+            sharding=NamedSharding(pmesh, spec),
+        )
+        ring = jax.shard_map(
+            lambda a, b, c: ring_flash_attention(
+                a, b, c, "sequence", 128, 128, False
+            ),
+            mesh=pmesh, in_specs=(spec, spec, spec), out_specs=spec,
+        )
+
+        def fwd_and_grad(q, k, v):
+            out = ring(q, k, v)
+            g = jax.grad(
+                lambda a, b, c: ring(a, b, c).astype(jnp.float32).sum(),
+                (0, 1, 2),
+            )(q, k, v)
+            return out, g
+
+        return jax.jit(fwd_and_grad).trace(qs, qs, qs).lower().compile()
+
+    progs["pod_ring_flash_131k_v5e_16x16"] = _compile(
+        "pod_ring_flash_131k_v5e_16x16", pod_ring_compile
     )
 
     # 9. Pod-scale sweep: the same SPMD programs compiled for full v5e

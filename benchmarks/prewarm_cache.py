@@ -129,7 +129,15 @@ def main() -> None:
             model = MODEL_REGISTRY["vit_s4"](num_classes=10,
                                              dtype=jnp.bfloat16)
             if impl == "flash":
-                model = model.clone(attention_impl=flash_attention)
+                # interpret=False explicitly: in this CPU process the
+                # None-default resolves to interpret mode and the trace
+                # would silently take the jnp fallback — a different
+                # program than the live on-chip bench compiles
+                model = model.clone(
+                    attention_impl=lambda q, k, v: flash_attention(
+                        q, k, v, 128, 128, False
+                    )
+                )
             tx = make_optimizer(lr=1e-2, momentum=0.9)
             step = make_train_step(model, tx, mesh)
             return step.trace(astate(model, tx), flat_batch(128))

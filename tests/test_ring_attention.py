@@ -206,3 +206,32 @@ def test_ring_flash_kernel_path_glue():
     np.testing.assert_allclose(
         np.asarray(jnp.concatenate([dv1, dv2], axis=1)),
         np.asarray(dv_ref), atol=5e-5, rtol=0, err_msg="dv")
+
+
+def test_ring_flash_scan_path_matches_full(devices, monkeypatch):
+    """Above _UNROLL_MAX the ring rolls into ONE lax.scan body (pod-scale
+    rings must not unroll hundreds of hops into the HLO); forced here at
+    n=8, fwd and all grads must still match full attention."""
+    import tpu_ddp.parallel.ring_attention as ra
+
+    monkeypatch.setattr(ra, "_UNROLL_MAX", 2)
+    q, k, v = _qkv(B=2, T=256, H=2, D=16, seed=6)
+    ring = _spec_map(
+        lambda a, b, c: ra.ring_flash_attention(a, b, c,
+                                                axis_name="sequence")
+    )
+    np.testing.assert_allclose(
+        np.asarray(ring(q, k, v)),
+        np.asarray(full_attention(q, k, v)), atol=2e-5, rtol=0,
+    )
+    w = jnp.cos(jnp.arange(q.shape[-1]))
+    g_ring = jax.grad(
+        lambda a, b, c: (ring(a, b, c) * w).sum(), (0, 1, 2))(q, k, v)
+    g_full = jax.grad(
+        lambda a, b, c: (full_attention(a, b, c) * w).sum(), (0, 1, 2)
+    )(q, k, v)
+    for name, got, want in zip("qkv", g_ring, g_full):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=5e-5, rtol=0,
+            err_msg=f"d{name}",
+        )

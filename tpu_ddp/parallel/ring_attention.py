@@ -136,6 +136,15 @@ def _combine(o, lse, o2, lse2):
     return o * w1 + o2 * w2, lse_new
 
 
+# Ring steps are unrolled below this axis size (a fixed chain XLA can
+# software-pipeline: each hop's collective-permute overlaps the next
+# tile's compute) and rolled into ONE lax.scan body above it — a
+# 256-chip pod ring would otherwise unroll 255 hops x 2 passes into the
+# HLO, exploding compile time. Compiler-friendly control flow is the
+# point: the scan body is compiled once regardless of ring size.
+_UNROLL_MAX = 8
+
+
 def _ring_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret):
     n = lax.axis_size(axis_name)
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
@@ -143,12 +152,25 @@ def _ring_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret):
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     o, lse = _block_fwd(q, k, v, scale, use_k, block_q, block_k, interpret)
-    for _ in range(n - 1):
-        k = lax.ppermute(k, axis_name, perm)
-        v = lax.ppermute(v, axis_name, perm)
-        o2, lse2 = _block_fwd(q, k, v, scale, use_k, block_q, block_k,
-                              interpret)
-        o, lse = _combine(o, lse, o2, lse2)
+    if n - 1 <= _UNROLL_MAX:
+        for _ in range(n - 1):
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+            o2, lse2 = _block_fwd(q, k, v, scale, use_k, block_q, block_k,
+                                  interpret)
+            o, lse = _combine(o, lse, o2, lse2)
+    else:
+        def hop(carry, _):
+            o, lse, k, v = carry
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+            o2, lse2 = _block_fwd(q, k, v, scale, use_k, block_q,
+                                  block_k, interpret)
+            o, lse = _combine(o, lse, o2, lse2)
+            return (o, lse, k, v), None
+
+        (o, lse, _, _), _ = lax.scan(hop, (o, lse, k, v), None,
+                                     length=n - 1)
     return o.astype(q.dtype), lse
 
 
@@ -202,10 +224,22 @@ def _rf_bwd(axis_name, block_q, block_k, interpret, res, g):
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     f32 = jnp.float32
-    dq = jnp.zeros(q.shape, f32)
-    dk = jnp.zeros(k.shape, f32)
-    dv = jnp.zeros(v.shape, f32)
-    for i in range(n):
+    # clean zeros marked varying over the inputs' full axis set — on a
+    # 2-D data x sequence mesh the scan carry type must vary over BOTH
+    # axes, which a plain jnp.zeros (device-invariant) does not. pvary,
+    # not x*0: multiplying would turn a non-finite input element into a
+    # NaN in the accumulator before any hop.
+    def _zeros_like_varying(x):
+        z = jnp.zeros(x.shape, f32)
+        vma = tuple(getattr(jax.typeof(x), "vma", ()) or ())
+        return lax.pcast(z, vma, to="varying") if vma else z
+
+    dq = _zeros_like_varying(q)
+    dk = _zeros_like_varying(k)
+    dv = _zeros_like_varying(v)
+
+    def hop(carry):
+        dq, dk, dv, k, v = carry
         dq_b, dk_b, dv_b = _block_bwd(
             q, k, v, out, lse, g, scale, use_k, block_q, block_k, interpret
         )
@@ -213,12 +247,22 @@ def _rf_bwd(axis_name, block_q, block_k, interpret, res, g):
         dk = dk + dk_b.astype(f32)
         dv = dv + dv_b.astype(f32)
         # rotate the KV blocks AND their gradient accumulators together:
-        # after the remaining hops they arrive home complete. (The final
-        # iteration's k/v rotation is dead code XLA drops.)
+        # after the remaining hops they arrive home complete. (On the
+        # unrolled path the final k/v rotation is dead code XLA drops.)
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
         dk = lax.ppermute(dk, axis_name, perm)
         dv = lax.ppermute(dv, axis_name, perm)
+        return dq, dk, dv, k, v
+
+    carry = (dq, dk, dv, k, v)
+    if n <= _UNROLL_MAX:
+        for _ in range(n):
+            carry = hop(carry)
+    else:
+        carry, _ = lax.scan(lambda c, _: (hop(c), None), carry, None,
+                            length=n)
+    dq, dk, dv = carry[0], carry[1], carry[2]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
