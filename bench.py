@@ -356,6 +356,53 @@ def _bench_attention() -> dict:
     return out
 
 
+def _attention_op_microbench() -> dict:
+    """Raw attention-op timing at T=2048 (bf16, B=4, H=8, D=128): the
+    long-sequence regime where the flash kernel's VMEM tiling matters,
+    timed fwd+bwd (grad wrt q,k,v) for both the Pallas kernel and the
+    fused-jnp reference on the same device."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_ddp.ops.flash_attention import _reference, flash_attention
+
+    B, T, H, D = 4, 2048, 8, 128
+    ks = jax.random.split(jax.random.key(3), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+               for kk in ks)
+
+    def time_impl(fn):
+        loss = jax.jit(jax.value_and_grad(
+            lambda a, b, c: fn(a, b, c).astype(jnp.float32).mean(),
+            (0, 1, 2),
+        ))
+        # same fencing discipline as _measure: compile, fence, size the
+        # timed window from one FENCED call (async dispatch returns in
+        # microseconds — an unfenced wall-clock budget never binds and
+        # would enqueue hundreds of in-flight 48MB output sets)
+        val, _ = loss(q, k, v)
+        val.block_until_ready()
+        t0 = time.perf_counter()
+        val, _ = loss(q, k, v)
+        val.block_until_ready()
+        per_call = max(time.perf_counter() - t0, 1e-6)
+        calls = int(max(3, min(100, 3.0 / per_call)))
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            val, _ = loss(q, k, v)
+        val.block_until_ready()
+        return calls / (time.perf_counter() - t0)
+
+    full_ips = time_impl(_reference)
+    flash_ips = time_impl(flash_attention)
+    return {
+        "shape": [B, T, H, D], "dtype": "bfloat16",
+        "full_calls_per_sec": round(full_ips, 2),
+        "flash_calls_per_sec": round(flash_ips, 2),
+        "flash_speedup": round(flash_ips / full_ips, 3),
+    }
+
+
 def _is_tpu_child() -> bool:
     # Child process only (tpu_ddp/jax are already imported here; the bench
     # PARENT must stay stdlib-only).
@@ -449,6 +496,11 @@ def child_main(quick: bool) -> None:
         # expensive program in the suite on this tunneled runtime, so it
         # runs LAST where a blown deadline costs only its own leg.
         _leg("attention_bench", _bench_attention)
+        _emit(out)
+        # the regime the flash kernel exists for (vit_s4's 64 tokens is
+        # not it); its own leg so a deadline kill mid-microbench cannot
+        # lose the already-emitted model rows
+        _leg("attention_op_T2048", _attention_op_microbench)
         _emit(out)
         # bf16 is EMULATED on CPU (round 2: the ResNet-50 bf16 config ran
         # >1200s there) — the compute-bound sub-bench is only meaningful,

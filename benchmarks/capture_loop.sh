@@ -22,7 +22,7 @@ try:
     doc = json.load(open("benchmarks/bench_tpu.json"))
 except Exception:
     doc = {}
-legs = ("baseline", "compute", "attention", "sweep")
+legs = ("baseline", "compute", "attention", "attention_op", "sweep")
 print(",".join(k for k in legs if k not in doc))
 EOF
 )

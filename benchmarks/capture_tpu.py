@@ -48,6 +48,8 @@ _LEG_CODE = {
                "bench._bench_compute_bound(False)))",
     "attention": "import bench; print(__import__('json').dumps("
                  "bench._bench_attention()))",
+    "attention_op": "import bench; print(__import__('json').dumps("
+                    "bench._attention_op_microbench()))",
     # Tuning sweep for the flagship: how far does scan-fusion amortize the
     # per-dispatch cost on the real chip? Reports img/s/chip per
     # (steps_per_call, per_shard_batch) point; the best point is the

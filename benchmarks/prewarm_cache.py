@@ -146,6 +146,32 @@ def main() -> None:
     jobs.append(("attention_full_vit_bf16_b128", attention("full")))
     jobs.append(("attention_flash_vit_bf16_b128", attention("flash")))
 
+    # bench._attention_op_microbench: raw-op fwd+bwd at T=2048, both impls
+    def attention_op(impl_name):
+        def go():
+            from tpu_ddp.ops.flash_attention import (
+                _reference,
+                flash_attention,
+            )
+
+            fn = (_reference if impl_name == "full"
+                  else lambda a, b, c: flash_attention(a, b, c, 128, 128,
+                                                       False))
+            # NO sharding attached: the live microbench jits plain
+            # uncommitted arrays (no mesh), and the cache key moves with
+            # the input-sharding construction
+            B, T, H, D = 4, 2048, 8, 128
+            qs = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16)
+            loss = jax.jit(jax.value_and_grad(
+                lambda a, b, c: fn(a, b, c).astype(jnp.float32).mean(),
+                (0, 1, 2),
+            ))
+            return loss.trace(qs, qs, qs)
+        return go
+
+    jobs.append(("attention_op_full_T2048", attention_op("full")))
+    jobs.append(("attention_op_flash_T2048", attention_op("flash")))
+
     # capture_tpu sweep points: scan K x per-shard batch
     for k in (32, 128):
         for per_shard in (32, 256):
