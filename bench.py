@@ -509,6 +509,7 @@ def child_main(quick: bool) -> None:
     else:
         out["compute_bound"] = {"skipped": "non-TPU backend (bf16 emulated)"}
         out["attention_bench"] = {"skipped": "non-TPU backend"}
+        out["attention_op_T2048"] = {"skipped": "non-TPU backend"}
     _promote_compute_headline(out)
     _emit(out)
 

@@ -157,11 +157,18 @@ def main() -> None:
             fn = (_reference if impl_name == "full"
                   else lambda a, b, c: flash_attention(a, b, c, 128, 128,
                                                        False))
-            # NO sharding attached: the live microbench jits plain
-            # uncommitted arrays (no mesh), and the cache key moves with
-            # the input-sharding construction
+            # The topology sharding is REQUIRED here even though the live
+            # microbench jits plain unsharded arrays: without it the
+            # deviceless trace targets the CPU backend, where the
+            # non-interpret Pallas kernel refuses to compile at all. The
+            # key-fidelity cost is the tool's documented caveat — an
+            # unshared-key miss just means a normal compile on-chip.
             B, T, H, D = 4, 2048, 8, 128
-            qs = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16)
+            sh = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec()
+            )
+            qs = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16,
+                                      sharding=sh)
             loss = jax.jit(jax.value_and_grad(
                 lambda a, b, c: fn(a, b, c).astype(jnp.float32).mean(),
                 (0, 1, 2),

@@ -75,9 +75,15 @@ def _run_cli(tmp_path, extra, epochs=1, resume=False):
 STRATEGY_CLI_FLAGS = {
     "fsdp": ["--parallelism", "fsdp", "--model", "resnet18"],
     "tp": ["--mesh", "data=2,model=4", "--model", "vit_s4"],
+    # the reference's own model family under channel-sharded conv TP
+    "tp_cnn": ["--mesh", "data=2,model=4", "--model", "netresdeep",
+               "--n-chans1", "8", "--n-blocks", "2"],
     "fsdp_tp": ["--parallelism", "fsdp_tp", "--mesh", "data=2,model=4", "--model", "vit_s4"],
     "pp": ["--mesh", "data=4,pipeline=2", "--model", "vit_s4"],
     "sp": ["--mesh", "data=4,sequence=2", "--model", "vit_s4"],
+    # flash-kernel ring blocks (jnp-tile fallback on the CPU mesh)
+    "sp_flash": ["--mesh", "data=4,sequence=2", "--sp-flash",
+                 "--model", "vit_s4"],
     "ep": ["--mesh", "data=4,expert=2", "--model", "vit_moe_s4"],
 }
 
