@@ -72,6 +72,21 @@ def test_resnet_family_forward(devices):
 
 # ------------------------------------------------------ flash ring --
 
+def _assert_grads_match(ring, q, k, v, atol=5e-5):
+    """ring's grads wrt q, k AND v must match full attention's."""
+    w = jnp.cos(jnp.arange(q.shape[-1]))
+    g_ring = jax.grad(
+        lambda a, b, c: (ring(a, b, c) * w).sum(), (0, 1, 2))(q, k, v)
+    g_full = jax.grad(
+        lambda a, b, c: (full_attention(a, b, c) * w).sum(), (0, 1, 2)
+    )(q, k, v)
+    for name, got, want in zip("qkv", g_ring, g_full):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=atol, rtol=0,
+            err_msg=f"d{name}",
+        )
+
+
 def _spec_map(fn):
     from jax.sharding import PartitionSpec as P
 
@@ -108,19 +123,7 @@ def test_ring_flash_grads_match_full_attention(devices):
     ring = _spec_map(
         lambda a, b, c: ring_flash_attention(a, b, c, axis_name="sequence")
     )
-    w = jnp.cos(jnp.arange(q.shape[-1]))
-
-    g_ring = jax.grad(
-        lambda a, b, c: (ring(a, b, c) * w).sum(), (0, 1, 2)
-    )(q, k, v)
-    g_full = jax.grad(
-        lambda a, b, c: (full_attention(a, b, c) * w).sum(), (0, 1, 2)
-    )(q, k, v)
-    for name, got, want in zip("qkv", g_ring, g_full):
-        np.testing.assert_allclose(
-            np.asarray(got), np.asarray(want), atol=5e-5, rtol=0,
-            err_msg=f"d{name}",
-        )
+    _assert_grads_match(ring, q, k, v)
 
 
 def test_sp_flash_vit_matches_plain_sp(devices):
@@ -224,14 +227,21 @@ def test_ring_flash_scan_path_matches_full(devices, monkeypatch):
         np.asarray(ring(q, k, v)),
         np.asarray(full_attention(q, k, v)), atol=2e-5, rtol=0,
     )
-    w = jnp.cos(jnp.arange(q.shape[-1]))
-    g_ring = jax.grad(
-        lambda a, b, c: (ring(a, b, c) * w).sum(), (0, 1, 2))(q, k, v)
-    g_full = jax.grad(
-        lambda a, b, c: (full_attention(a, b, c) * w).sum(), (0, 1, 2)
-    )(q, k, v)
-    for name, got, want in zip("qkv", g_ring, g_full):
-        np.testing.assert_allclose(
-            np.asarray(got), np.asarray(want), atol=5e-5, rtol=0,
-            err_msg=f"d{name}",
-        )
+    _assert_grads_match(ring, q, k, v)
+
+
+def test_plain_ring_scan_path_matches_full(devices, monkeypatch):
+    """The plain jnp ring shares the scan-above-threshold policy; forced
+    at n=8 it must still match full attention (fwd and autodiff grads —
+    no custom VJP here, lax.scan differentiates through the hops)."""
+    import tpu_ddp.parallel.ring_attention as ra
+
+    monkeypatch.setattr(ra, "_UNROLL_MAX", 2)
+    mesh = create_mesh(MeshSpec(data=1, sequence=8))
+    q, k, v = _qkv(seed=7)
+    ring = sequence_sharded_attention(mesh)
+    np.testing.assert_allclose(
+        np.asarray(ring(q, k, v)),
+        np.asarray(full_attention(q, k, v)), atol=2e-5, rtol=2e-5,
+    )
+    _assert_grads_match(ring, q, k, v)

@@ -40,6 +40,29 @@ def _block(q, k, v, scale):
     return o, m, l
 
 
+# Ring hops are unrolled below this axis size (a fixed chain XLA can
+# software-pipeline: each hop's collective-permute overlaps the next
+# tile's compute) and rolled into ONE lax.scan body above it — a
+# 256-chip pod ring would otherwise unroll hundreds of hops (x 2 passes
+# for the flash ring's custom VJP) into the HLO, exploding compile time.
+# Compiler-friendly control flow is the point: the scan body is compiled
+# once regardless of ring size. Shared by the plain ring, the flash-ring
+# forward, and its backward.
+_UNROLL_MAX = 8
+
+
+def _unroll_or_scan(hop, carry, steps: int):
+    """Run ``carry = hop(carry)`` ``steps`` times — unrolled when small,
+    one lax.scan otherwise. ``hop`` must be carry-type-preserving."""
+    if steps <= _UNROLL_MAX:
+        for _ in range(steps):
+            carry = hop(carry)
+        return carry
+    carry, _ = lax.scan(lambda c, _: (hop(c), None), carry, None,
+                        length=steps)
+    return carry
+
+
 def ring_attention(q, k, v, *, axis_name: str):
     """q,k,v: (B, T_local, H, D) sequence-sharded over `axis_name`.
     Returns (B, T_local, H, D) — this device's shard of exact full
@@ -48,11 +71,10 @@ def ring_attention(q, k, v, *, axis_name: str):
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
 
     o, m, l = _block(q, k, v, scale)
-    # Rotate K/V n-1 times; n is static (mesh shape), so a Python loop
-    # unrolls into a fixed chain of ppermute + fused attention tiles that
-    # XLA can pipeline (collective-permute overlapped with the next tile).
     perm = [(i, (i + 1) % n) for i in range(n)]
-    for _ in range(n - 1):
+
+    def hop(carry):
+        o, m, l, k, v = carry
         k = lax.ppermute(k, axis_name, perm)
         v = lax.ppermute(v, axis_name, perm)
         o2, m2, l2 = _block(q, k, v, scale)
@@ -61,7 +83,10 @@ def ring_attention(q, k, v, *, axis_name: str):
         a2 = jnp.exp(m2 - m_new)
         o = o * a1[..., None] + o2 * a2[..., None]
         l = l * a1 + l2 * a2
-        m = m_new
+        return o, m_new, l, k, v
+
+    carry = _unroll_or_scan(hop, (o, m, l, k, v), n - 1)
+    o, m, l = carry[0], carry[1], carry[2]
     out = o / l[..., None]  # (B,H,Tq,D)
     return out.transpose(0, 2, 1, 3)  # -> (B, Tq, H, D)
 
@@ -136,15 +161,6 @@ def _combine(o, lse, o2, lse2):
     return o * w1 + o2 * w2, lse_new
 
 
-# Ring steps are unrolled below this axis size (a fixed chain XLA can
-# software-pipeline: each hop's collective-permute overlaps the next
-# tile's compute) and rolled into ONE lax.scan body above it — a
-# 256-chip pod ring would otherwise unroll 255 hops x 2 passes into the
-# HLO, exploding compile time. Compiler-friendly control flow is the
-# point: the scan body is compiled once regardless of ring size.
-_UNROLL_MAX = 8
-
-
 def _ring_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret):
     n = lax.axis_size(axis_name)
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
@@ -152,25 +168,17 @@ def _ring_fwd_impl(q, k, v, axis_name, block_q, block_k, interpret):
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     o, lse = _block_fwd(q, k, v, scale, use_k, block_q, block_k, interpret)
-    if n - 1 <= _UNROLL_MAX:
-        for _ in range(n - 1):
-            k = lax.ppermute(k, axis_name, perm)
-            v = lax.ppermute(v, axis_name, perm)
-            o2, lse2 = _block_fwd(q, k, v, scale, use_k, block_q, block_k,
-                                  interpret)
-            o, lse = _combine(o, lse, o2, lse2)
-    else:
-        def hop(carry, _):
-            o, lse, k, v = carry
-            k = lax.ppermute(k, axis_name, perm)
-            v = lax.ppermute(v, axis_name, perm)
-            o2, lse2 = _block_fwd(q, k, v, scale, use_k, block_q,
-                                  block_k, interpret)
-            o, lse = _combine(o, lse, o2, lse2)
-            return (o, lse, k, v), None
 
-        (o, lse, _, _), _ = lax.scan(hop, (o, lse, k, v), None,
-                                     length=n - 1)
+    def hop(carry):
+        o, lse, k, v = carry
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        o2, lse2 = _block_fwd(q, k, v, scale, use_k, block_q, block_k,
+                              interpret)
+        o, lse = _combine(o, lse, o2, lse2)
+        return o, lse, k, v
+
+    o, lse, _, _ = _unroll_or_scan(hop, (o, lse, k, v), n - 1)
     return o.astype(q.dtype), lse
 
 
@@ -255,13 +263,7 @@ def _rf_bwd(axis_name, block_q, block_k, interpret, res, g):
         dv = lax.ppermute(dv, axis_name, perm)
         return dq, dk, dv, k, v
 
-    carry = (dq, dk, dv, k, v)
-    if n <= _UNROLL_MAX:
-        for _ in range(n):
-            carry = hop(carry)
-    else:
-        carry, _ = lax.scan(lambda c, _: (hop(c), None), carry, None,
-                            length=n)
+    carry = _unroll_or_scan(hop, (dq, dk, dv, k, v), n)
     dq, dk, dv = carry[0], carry[1], carry[2]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
