@@ -324,7 +324,15 @@ def _bench_attention() -> dict:
     g_fl = jax.grad(lambda a, b, c: flash_attention(a, b, c).sum(), (0, 1, 2))(q, k, v)
     g_rf = jax.grad(lambda a, b, c: _reference(a, b, c).sum(), (0, 1, 2))(q, k, v)
     bwd_err = float(max(jnp.max(jnp.abs(x - y)) for x, y in zip(g_fl, g_rf)))
-    assert fwd_err < 5e-5 and bwd_err < 5e-4, (fwd_err, bwd_err)
+    # On a physical TPU, BOTH programs round their f32 matmuls through the
+    # MXU's bf16 pass at default precision, so kernel-vs-reference max-abs
+    # error lands at bf16 rounding scale (measured on v5e: fwd 1.8e-3,
+    # bwd 2.5e-3) — that is accumulation-order noise, not a wrong kernel.
+    # The tight f32 bound still applies off-TPU (CPU runs f32 exactly; the
+    # CPU suite pins it in tests/test_flash_attention.py).
+    on_tpu = jax.devices()[0].platform != "cpu"
+    fwd_tol, bwd_tol = (8e-3, 1.5e-2) if on_tpu else (5e-5, 5e-4)
+    assert fwd_err < fwd_tol and bwd_err < bwd_tol, (fwd_err, bwd_err)
 
     devices = jax.devices()
     n_chips = len(devices)
