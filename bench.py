@@ -253,7 +253,12 @@ def _bench_dispatch_baseline() -> dict:
     }
 
 
-def _bench_compute_bound(quick: bool) -> dict:
+def _resnet50_bf16_point(per_shard: int, *, max_calls: int = 50) -> dict:
+    """ONE measured ResNet-50 bf16 train-step point at the given per-shard
+    batch. The headline compute leg and the batch sweep both call this, so
+    the sweep is structurally the SAME measurement as the headline — same
+    optimizer knobs, same seed, same measurement discipline — varying only
+    the batch."""
     import jax
     import numpy as np
 
@@ -272,7 +277,6 @@ def _bench_compute_bound(quick: bool) -> dict:
     state = create_train_state(model, tx, jax.random.key(0))
     step = make_train_step(model, tx, mesh)
 
-    per_shard = 64 if quick else 256
     global_batch = per_shard * n_chips
     imgs, labels = synthetic_cifar10(global_batch, seed=1)
     batch = {
@@ -283,18 +287,85 @@ def _bench_compute_bound(quick: bool) -> dict:
     batch = jax.device_put(batch, batch_sharding(mesh))
 
     flops_per_call = compiled_flops(step, state, batch)
-    _, calls, elapsed = _measure(
-        step, state, batch, max_calls=3 if quick else 50
-    )
+    _, calls, elapsed = _measure(step, state, batch, max_calls=max_calls)
     per_chip = calls * global_batch / elapsed / n_chips
     return {
         "images_per_sec_per_chip": round(per_chip, 1),
         "mfu": mfu(flops_per_call, calls / elapsed),
-        "model": "resnet50",
-        "dtype": "bfloat16",
         "per_shard_batch": per_shard,
         "n_chips": n_chips,
     }
+
+
+def _bench_compute_bound(quick: bool) -> dict:
+    point = _resnet50_bf16_point(
+        64 if quick else 256, max_calls=3 if quick else 50
+    )
+    return {"model": "resnet50", "dtype": "bfloat16", **point}
+
+
+def _bench_vit_compute() -> dict:
+    """ViT-B/16 bf16 at 224x224 (196 tokens, hidden 768): the
+    matmul-dominated compute leg. ResNet-50 on 32x32 CIFAR leaves the MXU
+    under-tiled by tiny spatial maps; this is the config that shows what
+    the framework's train step does when the FLOPs are MXU-shaped."""
+    import jax
+    import numpy as np
+
+    from tpu_ddp.metrics.mfu import compiled_flops, mfu
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+    from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
+    from tpu_ddp.train import create_train_state, make_optimizer, make_train_step
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = create_mesh(MeshSpec(data=-1), devices)
+
+    model = MODEL_REGISTRY["vit_b16"](
+        num_classes=1000, dtype=jax.numpy.bfloat16
+    )
+    tx = make_optimizer(lr=1e-3, momentum=0.9)
+    state = create_train_state(
+        model, tx, jax.random.key(0), input_shape=(1, 224, 224, 3)
+    )
+    step = make_train_step(model, tx, mesh)
+
+    per_shard = 64
+    global_batch = per_shard * n_chips
+    rng = np.random.default_rng(3)
+    batch = {
+        "image": rng.standard_normal(
+            (global_batch, 224, 224, 3), dtype=np.float32),
+        "label": rng.integers(0, 1000, global_batch),
+        "mask": np.ones(global_batch, bool),
+    }
+    batch = jax.device_put(batch, batch_sharding(mesh))
+
+    flops_per_call = compiled_flops(step, state, batch)
+    _, calls, elapsed = _measure(step, state, batch, max_calls=30)
+    per_chip = calls * global_batch / elapsed / n_chips
+    return {
+        "images_per_sec_per_chip": round(per_chip, 1),
+        "mfu": mfu(flops_per_call, calls / elapsed),
+        "model": "vit_b16",
+        "dtype": "bfloat16",
+        "image_size": 224,
+        "per_shard_batch": per_shard,
+        "n_chips": n_chips,
+    }
+
+
+def _bench_compute_sweep() -> dict:
+    """Per-shard batch sweep around the committed ResNet-50 bf16 point:
+    does more batch buy MFU on this chip, or is 256 already saturated?
+    Each point is a fresh `_resnet50_bf16_point` call (fresh state per
+    point — the jitted step donates its input state, so reusing one state
+    across points would reference deleted buffers)."""
+    points = [
+        _resnet50_bf16_point(per_shard, max_calls=30)
+        for per_shard in (128, 512)  # 256 is the committed compute leg
+    ]
+    return {"model": "resnet50", "dtype": "bfloat16", "points": points}
 
 
 def _bench_attention() -> dict:
@@ -514,10 +585,15 @@ def child_main(quick: bool) -> None:
         # >1200s there) — the compute-bound sub-bench is only meaningful,
         # and only affordable, on a real accelerator.
         _leg("compute_bound", lambda: _bench_compute_bound(quick))
+        _emit(out)
+        # matmul-shaped compute (ViT-B/16 @224): the MXU ceiling the conv
+        # stack can't reach on 32x32 inputs; last = cheapest to lose
+        _leg("vit_compute", _bench_vit_compute)
     else:
         out["compute_bound"] = {"skipped": "non-TPU backend (bf16 emulated)"}
         out["attention_bench"] = {"skipped": "non-TPU backend"}
         out["attention_op_T2048"] = {"skipped": "non-TPU backend"}
+        out["vit_compute"] = {"skipped": "non-TPU backend"}
     _promote_compute_headline(out)
     _emit(out)
 
@@ -557,6 +633,17 @@ def _promote_compute_headline(out: dict) -> None:
         out["headline_row"] = "compute_bound_resnet50_bf16"
     else:
         out["headline_row"] = "dispatch_fused_flagship"
+    vc = out.get("vit_compute") or {}
+    vc_v = vc.get("images_per_sec_per_chip") if isinstance(vc, dict) else None
+    if vc_v:
+        rows["matmul_bound_vit_b16_bf16"] = {
+            "metric": "vit_b16_bf16_train_images_per_sec_per_chip",
+            "value": vc_v,
+            "unit": "images/sec/chip",
+            "mfu": vc.get("mfu"),
+            "note": "matmul-shaped compute: ViT-B/16 bf16 at 224x224; the "
+                    "headline stays the reference-family CNN",
+        }
     out["vs_baseline_row"] = "dispatch_fused_flagship"
     out["rows"] = rows
 
@@ -614,6 +701,39 @@ def _probe_backend(env, timeout=None) -> tuple:
         return True, json.loads(stdout.strip().splitlines()[-1])
     except (json.JSONDecodeError, IndexError):
         return False, "probe printed no JSON"
+
+
+def run_grant_safe_child(argv, timeout_s: float, *, env=None,
+                         grace: float = 20.0):
+    """The ONE grant-safe child choreography, shared by every capture tool
+    (capture_tpu.py legs, tpu_curve.py arms, tpu_recipe.py): spawn with
+    merged stdout, register in ``_ACTIVE_CHILD`` so any caller's SIGTERM
+    handler reaps a grant-holding child, and on timeout TERM-then-KILL via
+    ``_terminate_gracefully`` — never a bare SIGKILL, which orphans the TPU
+    pool grant and wedges every later client. Returns ``(out, err, wall)``:
+    ``err`` is None on success, else a timeout message or an ``rc=N: tail``
+    summary of the child's last output lines."""
+    global _ACTIVE_CHILD
+    t0 = time.time()
+    proc = subprocess.Popen(
+        argv, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=_REPO,
+    )
+    _ACTIVE_CHILD = proc
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        _terminate_gracefully(proc, grace=grace)
+        out, _ = proc.communicate()
+        return (out or "", f"timed out after {timeout_s:.0f}s",
+                time.time() - t0)
+    finally:
+        _ACTIVE_CHILD = None
+    wall = time.time() - t0
+    if proc.returncode != 0:
+        tail = " | ".join((out or "").strip().splitlines()[-4:])
+        return out or "", f"rc={proc.returncode}: {tail}", wall
+    return out or "", None, wall
 
 
 def _run_child(env, quick: bool, results_path: str, timeout_s: float):

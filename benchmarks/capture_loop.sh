@@ -1,20 +1,22 @@
 #!/bin/bash
 # Retry on-chip capture until every target leg lands, then convert the
-# remaining window into the accuracy-curve artifact — all under one
-# deadline. capture_tpu.py and tpu_curve.py both probe first and exit 0
-# without queueing when the pool is wedged, so looping them is
-# grant-safe; the tools run strictly sequentially (one pool client at a
-# time). Each capture iteration requests ONLY the still-missing legs:
-# grant time is precious and a re-run would clobber an already-captured
-# number with a noisier one. The curve phase retries on wedged probes
-# (summary.json only appears once a probe succeeded) and only launches
-# when enough of the deadline remains to finish inside the window.
+# remaining window into the accuracy-curve and on-chip-recipe artifacts —
+# all under one deadline. capture_tpu.py, tpu_curve.py and tpu_recipe.py
+# all probe first and exit 0 without queueing when the pool is wedged, so
+# looping them is grant-safe; the tools run strictly sequentially (one
+# pool client at a time). Each capture iteration requests ONLY the
+# still-missing legs: grant time is precious and a re-run would clobber an
+# already-captured number with a noisier one. The curve/recipe phases
+# retry on wedged probes (their summary.json only appears once a probe
+# succeeded) and only launch when enough of the deadline remains to finish
+# inside the window.
 cd /root/repo
 LOCK=/tmp/tpu_capture_loop.lock
 exec 9>"$LOCK"
 flock -n 9 || { echo "capture loop already running"; exit 0; }
-DEADLINE=$(( $(date +%s) + 11*3600 ))
-CURVE_BUDGET=3600  # probe + 2 arms x 1500s + plot, worst case
+DEADLINE=$(( $(date +%s) + 10*3600 ))
+CURVE_BUDGET=3600   # probe + 2 arms x 1500s + plot, worst case
+RECIPE_BUDGET=2700  # probe + 2 arms x 2 seeds through the CLI, worst case
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   MISSING=$(python - <<'EOF'
 import json
@@ -22,30 +24,34 @@ try:
     doc = json.load(open("benchmarks/bench_tpu.json"))
 except Exception:
     doc = {}
-legs = ("baseline", "compute", "attention", "attention_op", "sweep")
+# "flagship" is in the target set so a FRESH doc (new chip / deliberate
+# re-measure) still captures the row the headline's vs_baseline ratio
+# needs; in the committed doc it already exists and is never re-requested.
+legs = ("flagship", "baseline", "compute", "attention", "attention_op",
+        "sweep", "vit_compute", "compute_sweep")
 print(",".join(k for k in legs if k not in doc))
 EOF
 )
-  if [ -z "$MISSING" ]; then
-    if [ -f benchmarks/tpu_curve/summary.json ]; then
-      echo "bench legs + accuracy curve captured; loop done"
-      exit 0
-    fi
-    REMAIN=$(( DEADLINE - $(date +%s) ))
-    if [ "$REMAIN" -ge "$CURVE_BUDGET" ]; then
-      python benchmarks/tpu_curve.py --epochs 24 --arm-timeout 1500 \
-        >> benchmarks/capture_r4.log 2>&1
-      # a wedged probe writes nothing; retry next iteration
-      if [ -f benchmarks/tpu_curve/summary.json ]; then
-        echo "bench legs + accuracy curve captured; loop done"
-        exit 0
-      fi
-    else
-      echo "deadline too close for a curve run (${REMAIN}s left); waiting out"
-    fi
-  else
+  REMAIN=$(( DEADLINE - $(date +%s) ))
+  if [ -n "$MISSING" ]; then
     python benchmarks/capture_tpu.py --legs "$MISSING" --leg-timeout 900 \
       >> benchmarks/capture_r4.log 2>&1
+  elif [ ! -f benchmarks/tpu_curve/summary.json ] \
+      && [ "$REMAIN" -ge "$CURVE_BUDGET" ]; then
+    python benchmarks/tpu_curve.py --epochs 24 --arm-timeout 1500 \
+      >> benchmarks/capture_r4.log 2>&1
+  elif [ ! -f benchmarks/recipe_demo_tpu/summary.json ] \
+      && [ "$REMAIN" -ge "$RECIPE_BUDGET" ]; then
+    # independent of the curve: a window too short for the curve can
+    # still fit the recipe run
+    python benchmarks/tpu_recipe.py --timeout 2400 \
+      >> benchmarks/capture_r4.log 2>&1
+  elif [ -f benchmarks/tpu_curve/summary.json ] \
+      && [ -f benchmarks/recipe_demo_tpu/summary.json ]; then
+    echo "bench legs + accuracy curve + on-chip recipe captured; loop done"
+    exit 0
+  else
+    echo "remaining phases need more window than ${REMAIN}s; waiting"
   fi
   sleep 720
 done

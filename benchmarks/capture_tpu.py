@@ -50,6 +50,10 @@ _LEG_CODE = {
                  "bench._bench_attention()))",
     "attention_op": "import bench; print(__import__('json').dumps("
                     "bench._attention_op_microbench()))",
+    "vit_compute": "import bench; print(__import__('json').dumps("
+                   "bench._bench_vit_compute()))",
+    "compute_sweep": "import bench; print(__import__('json').dumps("
+                     "bench._bench_compute_sweep()))",
     # Tuning sweep for the flagship: how far does scan-fusion amortize the
     # per-dispatch cost on the real chip? Reports img/s/chip per
     # (steps_per_call, per_shard_batch) point; the best point is the
@@ -108,14 +112,12 @@ import bench  # noqa: E402  (stdlib-only at module level; never imports jax)
 # benchmarks/ dir and never raises).
 _record = bench._record_attempt
 
-_ACTIVE_LEG = None  # the currently-running leg child (for _on_term)
-
-
 def _on_term(signum, frame):
     # Being TERM'd while a leg child holds the TPU pool grant must not
     # orphan it (a SIGKILLed/orphaned grant-holder wedges every later
-    # client; see bench._terminate_gracefully).
-    child = _ACTIVE_LEG or bench._ACTIVE_CHILD  # leg, or a mid-probe client
+    # client; see bench._terminate_gracefully). Legs and probes both
+    # register in bench._ACTIVE_CHILD via run_grant_safe_child.
+    child = bench._ACTIVE_CHILD
     if child is not None:
         bench._terminate_gracefully(child, grace=20)
     raise SystemExit(124)
@@ -130,29 +132,19 @@ def _probe(timeout: float = 75.0):
 
 
 def _run_leg(name: str, timeout: float):
-    global _ACTIVE_LEG
-    t0 = time.time()
-    p = subprocess.Popen(
-        [sys.executable, "-u", "-c", _PRELUDE + _LEG_CODE[name]],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, cwd=_REPO,
+    out, err, wall = bench.run_grant_safe_child(
+        [sys.executable, "-u", "-c", _PRELUDE + _LEG_CODE[name]], timeout
     )
-    _ACTIVE_LEG = p
-    try:
-        out, errout = p.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        bench._terminate_gracefully(p, grace=20)
-        p.communicate()
-        return None, f"leg timed out after {timeout:.0f}s", time.time() - t0
-    finally:
-        _ACTIVE_LEG = None
-    wall = time.time() - t0
-    if p.returncode != 0:
-        tail = " | ".join((errout or "").strip().splitlines()[-3:])
-        return None, f"rc={p.returncode}: {tail}", wall
-    try:
-        return json.loads(out.strip().splitlines()[-1]), None, wall
-    except (json.JSONDecodeError, IndexError):
-        return None, "no JSON on stdout", wall
+    if err is not None:
+        return None, err, wall
+    # merged stdout+stderr: a late async warning can land after the leg's
+    # JSON line, so take the last line that parses
+    for line in reversed(out.strip().splitlines()):
+        try:
+            return json.loads(line), None, wall
+        except json.JSONDecodeError:
+            continue
+    return None, "no JSON on stdout", wall
 
 
 def _write_doc(doc: dict) -> None:
@@ -202,13 +194,20 @@ def main() -> None:
             if cb.get("images_per_sec_per_chip"):
                 # round-3 verdict item 7: once a compute-bound number
                 # exists it is the headline; the scan-fused flagship stays
-                # as its own row (doc["flagship"]), never conflated
+                # as its own row (doc["flagship"]), never conflated. The
+                # rebuild must not drop vs_baseline fields an earlier
+                # iteration already computed (the ratio block below only
+                # re-derives them while BOTH source rows are in the doc).
+                old = doc.get("headline") or {}
                 doc["headline"] = {
                     "metric": "resnet50_bf16_train_images_per_sec_per_chip",
                     "value": cb["images_per_sec_per_chip"],
                     "unit": "images/sec/chip",
                     "mfu": cb.get("mfu"),
                     "headline_row": "compute",
+                    **{k: old[k] for k in (
+                        "vs_baseline", "vs_baseline_source",
+                        "vs_baseline_row") if k in old},
                 }
             # Once the measured dispatch-per-step baseline exists, the
             # fallback-constant vs_baseline in the committed doc is

@@ -39,11 +39,12 @@ sys.path.insert(0, _REPO)
 import bench  # noqa: E402  (stdlib-only at module level)
 
 _record = bench._record_attempt
-_ACTIVE = None
 
 
 def _on_term(signum, frame):
-    child = _ACTIVE or bench._ACTIVE_CHILD
+    # arms and probes both register in bench._ACTIVE_CHILD via
+    # run_grant_safe_child; a TERM mid-arm must not orphan the pool grant
+    child = bench._ACTIVE_CHILD
     if child is not None:
         bench._terminate_gracefully(child, grace=20)
     raise SystemExit(124)
@@ -67,32 +68,17 @@ def _arm_argv(name: str, model: str, epochs: int, extra: list) -> list:
 
 
 def _run_arm(name: str, argv: list, timeout: float):
-    global _ACTIVE
     code = (
         "import sys, json; sys.path.insert(0, {repo!r}); "
         "from tpu_ddp.cli.train import main; "
         "r = main({argv!r}); "
         "print('ARM_RESULT ' + json.dumps(r))"
     ).format(repo=_REPO, argv=argv)
-    t0 = time.time()
-    p = subprocess.Popen(
-        [sys.executable, "-u", "-c", code],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        cwd=_REPO,
+    out, err, wall = bench.run_grant_safe_child(
+        [sys.executable, "-u", "-c", code], timeout
     )
-    _ACTIVE = p
-    try:
-        out, _ = p.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        bench._terminate_gracefully(p, grace=20)
-        p.communicate()
-        return None, f"arm timed out after {timeout:.0f}s", time.time() - t0
-    finally:
-        _ACTIVE = None
-    wall = time.time() - t0
-    if p.returncode != 0:
-        tail = " | ".join(out.strip().splitlines()[-4:])
-        return None, f"rc={p.returncode}: {tail}", wall
+    if err is not None:
+        return None, err, wall
     for line in out.splitlines():
         if line.startswith("ARM_RESULT "):
             return json.loads(line[len("ARM_RESULT "):]), None, wall

@@ -24,9 +24,7 @@ import argparse
 import json
 import os
 import signal
-import subprocess
 import sys
-import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _OUT_DIR = os.path.join(_REPO, "benchmarks", "recipe_demo_tpu")
@@ -34,11 +32,11 @@ _OUT_DIR = os.path.join(_REPO, "benchmarks", "recipe_demo_tpu")
 sys.path.insert(0, _REPO)
 import bench  # noqa: E402  (stdlib-only at module level)
 
-_ACTIVE = None
-
 
 def _on_term(signum, frame):
-    child = _ACTIVE or bench._ACTIVE_CHILD
+    # the demo child and probes both register in bench._ACTIVE_CHILD via
+    # run_grant_safe_child; a TERM mid-demo must not orphan the pool grant
+    child = bench._ACTIVE_CHILD
     if child is not None:
         bench._terminate_gracefully(child, grace=20)
     raise SystemExit(124)
@@ -81,36 +79,25 @@ def main() -> None:
     stale = os.path.join(_OUT_DIR, "summary.json")
     if os.path.exists(stale):
         os.unlink(stale)
-    global _ACTIVE
-    t0 = time.time()
-    p = subprocess.Popen(demo_argv, stdout=subprocess.PIPE,
-                         stderr=subprocess.STDOUT, text=True, cwd=_REPO)
-    _ACTIVE = p
-    try:
-        out, _ = p.communicate(timeout=args.timeout)
-    except subprocess.TimeoutExpired:
-        bench._terminate_gracefully(p, grace=20)
-        p.communicate()
-        bench._record_attempt(
-            "tpu_recipe", ok=False,
-            error=f"timed out after {args.timeout:.0f}s",
-            wall_s=round(time.time() - t0, 1),
-        )
-        print("tpu_recipe: timed out", flush=True)
-        return
-    finally:
-        _ACTIVE = None
-    wall = time.time() - t0
+    out, err, wall = bench.run_grant_safe_child(demo_argv, args.timeout)
     summary = None
     try:
         with open(os.path.join(_OUT_DIR, "summary.json")) as f:
             summary = json.load(f)
     except (OSError, json.JSONDecodeError):
-        pass
-    err = None
-    if p.returncode != 0 or summary is None:
-        err = (f"rc={p.returncode}: "
+        # A TERM'd/crashed child can leave a truncated summary.json
+        # (recipe_demo writes it non-atomically); it must not survive to
+        # satisfy capture_loop.sh's existence check as phase-complete.
+        if os.path.exists(stale):
+            os.unlink(stale)
+    if err is None and summary is None:
+        err = ("demo exited 0 but wrote no summary.json: "
                + " | ".join(out.strip().splitlines()[-4:]))
+    if summary is None and err is not None and "timed out" in err:
+        bench._record_attempt("tpu_recipe", ok=False, error=err,
+                              wall_s=round(wall, 1))
+        print("tpu_recipe: timed out", flush=True)
+        return
     bench._record_attempt(
         "tpu_recipe", ok=err is None, error=err, wall_s=round(wall, 1),
         result=None if summary is None else {
