@@ -30,7 +30,6 @@ import os
 import signal
 import subprocess
 import sys
-import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _OUT_DIR = os.path.join(_REPO, "benchmarks", "tpu_curve")

@@ -33,6 +33,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fix the GLOBAL batch instead (sane mode; divided "
                         "across devices)")
     p.add_argument("--lr", type=float, default=1e-2)
+    p.add_argument("--optimizer", choices=["sgd", "adamw", "lamb"],
+                   default="sgd",
+                   help="sgd = the reference family (main.py:27); adamw = "
+                        "the ViT-family recipe; lamb = layer-wise-adaptive "
+                        "large-global-batch training")
     p.add_argument("--momentum", type=float, default=0.0)
     p.add_argument("--weight-decay", type=float, default=0.0)
     p.add_argument("--schedule", choices=["constant", "cosine"], default="constant")
@@ -229,6 +234,7 @@ def config_from_args(args) -> TrainConfig:
         epochs=args.epochs,
         per_shard_batch=per_shard,
         lr=args.lr,
+        optimizer=args.optimizer,
         momentum=args.momentum,
         weight_decay=args.weight_decay,
         schedule=None if args.schedule == "constant" else args.schedule,

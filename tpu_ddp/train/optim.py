@@ -15,6 +15,16 @@ from typing import Callable, Optional
 import optax
 
 
+def _decay_mask(params):
+    # Kernels only (ndim >= 2): decaying BatchNorm scales/offsets and
+    # biases hurts accuracy — the standard exclusion every modern
+    # CIFAR/ImageNet recipe applies (part of the 93% pathway, BASELINE.md).
+    # The reference never uses weight decay at all (main.py:27).
+    import jax
+
+    return jax.tree.map(lambda p: getattr(p, "ndim", 0) >= 2, params)
+
+
 def make_optimizer(
     lr: float = 1e-2,
     momentum: float = 0.0,
@@ -24,11 +34,19 @@ def make_optimizer(
     warmup_steps: int = 0,
     grad_clip_norm: float = 0.0,
     freeze_predicate: Optional[Callable[[tuple, object], bool]] = None,
+    optimizer: str = "sgd",
 ) -> optax.GradientTransformation:
     """freeze_predicate(path_tuple, leaf) -> True to FREEZE that param.
     ``grad_clip_norm`` > 0 clips the GLOBAL gradient norm before the update
     — on the DP step the clip sees the pmean'd (already-synchronized)
-    gradient, so every replica clips identically."""
+    gradient, so every replica clips identically.
+
+    ``optimizer``: ``sgd`` (the reference's family, ``main.py:27`` /
+    ``ppe_main_ddp.py:133``), ``adamw`` (the ViT-family default — ViT
+    trains poorly under SGD-momentum), or ``lamb`` (layer-wise-adaptive
+    large-global-batch training, the regime a data-parallel framework
+    scales into). adamw/lamb decay decoupled-style inside the transform
+    with the same kernels-only mask sgd uses for its coupled decay."""
     if grad_clip_norm < 0:
         raise ValueError(f"grad_clip_norm must be >= 0, got {grad_clip_norm}")
     if schedule == "cosine":
@@ -41,22 +59,25 @@ def make_optimizer(
     else:
         raise ValueError(f"unknown schedule {schedule!r}")
 
-    tx = optax.sgd(lr_sched, momentum=momentum if momentum > 0 else None)
-    if weight_decay > 0:
-        # Kernels only (ndim >= 2): decaying BatchNorm scales/offsets and
-        # biases hurts accuracy — the standard exclusion every modern
-        # CIFAR/ImageNet recipe applies (part of the 93% pathway,
-        # BASELINE.md). The reference never uses weight decay at all
-        # (main.py:27).
-        def _decay_mask(params):
-            import jax
-
-            return jax.tree.map(lambda p: getattr(p, "ndim", 0) >= 2, params)
-
-        tx = optax.chain(
-            optax.masked(optax.add_decayed_weights(weight_decay), _decay_mask),
-            tx,
-        )
+    if optimizer == "sgd":
+        tx = optax.sgd(lr_sched, momentum=momentum if momentum > 0 else None)
+        if weight_decay > 0:
+            tx = optax.chain(
+                optax.masked(
+                    optax.add_decayed_weights(weight_decay), _decay_mask
+                ),
+                tx,
+            )
+    elif optimizer in ("adamw", "lamb"):
+        if momentum > 0:
+            raise ValueError(
+                f"--momentum is an SGD knob; {optimizer} has its own "
+                "moment estimates (b1=0.9)"
+            )
+        factory = optax.adamw if optimizer == "adamw" else optax.lamb
+        tx = factory(lr_sched, weight_decay=weight_decay, mask=_decay_mask)
+    else:
+        raise ValueError(f"unknown optimizer {optimizer!r}")
     if grad_clip_norm > 0:
         # Outermost: the clip sees the RAW (synchronized) gradient; the
         # weight-decay term (coupled: added pre-lr, so effective decay is

@@ -47,6 +47,8 @@ class TrainConfig:
     epochs: int = 99                      # range(1,100), main.py:30
     per_shard_batch: int = 32             # per-process bs, main.py:61
     lr: float = 1e-2                      # main.py:27
+    optimizer: str = "sgd"                # sgd | adamw (ViT family) | lamb
+                                          # (large-global-batch)
     momentum: float = 0.0                 # reference SGD has none
     weight_decay: float = 0.0
     schedule: Optional[str] = None        # "cosine" | None
@@ -235,6 +237,7 @@ class Trainer:
             freeze = freeze_all_but(tuple(config.freeze_prefixes))
         self.tx = make_optimizer(
             lr=config.lr,
+            optimizer=config.optimizer,
             momentum=config.momentum,
             weight_decay=config.weight_decay,
             schedule=config.schedule,
