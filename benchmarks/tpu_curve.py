@@ -49,15 +49,27 @@ def _on_term(signum, frame):
     raise SystemExit(124)
 
 
+_GLOBAL_BATCH = 256  # the batch every arm's recipe is tuned at (see below)
+
+
 def _arm_argv(name: str, model: str, epochs: int, extra: list) -> list:
-    jsonl = os.path.join(_OUT_DIR, f"{name}.jsonl")
+    # The child writes per-epoch records to a .new path; the caller
+    # promotes it over the committed jsonl ONLY on success, so a failed
+    # rerun cannot destroy a prior good curve.
+    jsonl = os.path.join(_OUT_DIR, f"{name}.jsonl.new")
     return [
         "--device", "tpu",
         "--synthetic-data", "--synthetic-task", "hard",
         "--synthetic-size", "4096", "--synthetic-label-noise", "0.1",
         "--model", model,
         "--epochs", str(epochs),
-        "--batch-size", "32",
+        # GLOBAL batch on the single chip — the batch the committed
+        # recipe demo's knobs are tuned at (32/shard x 8 workers). The
+        # first on-chip attempt ran --batch-size 32 (global 32 on 1 chip)
+        # and the lr-5e-3+momentum recipe collapsed the tiny flagship to
+        # chance (attempts.jsonl ts 1785463*): the recipe is batch-
+        # coupled, so the curve must run at the recipe's batch.
+        "--batch-size", str(_GLOBAL_BATCH),
         "--eval-each-epoch",
         "--log-every-epochs", str(epochs),
         "--jsonl", jsonl,
@@ -99,7 +111,8 @@ def _curve(jsonl_path: str) -> list:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=24)
+    ap.add_argument("--epochs", type=int, default=24)  # capture_loop's
+    # horizon; each leg records its OWN epochs (partial reruns may differ)
     ap.add_argument("--arm-timeout", type=float, default=1800.0)
     ap.add_argument("--arms", default="netresdeep,resnet18")
     args = ap.parse_args()
@@ -115,40 +128,70 @@ def main() -> None:
     print(f"tpu_curve: chip up: {info}", flush=True)
     _record("tpu_curve_probe", ok=True, info=info)
 
-    # Framework-recipe knobs mirror the committed recipe demo's framework
-    # arm (benchmarks/recipe_demo.py); resnet18 runs the same recipe on the
-    # deeper model.
-    recipe = ["--lr", "0.005", "--sync-bn", "--momentum", "0.9",
-              "--weight-decay", "5e-4"]
+    # Per-arm recipes, each at the batch it was tuned for (global 256):
+    # netresdeep uses the committed recipe demo's framework knobs
+    # (benchmarks/recipe_demo.py — measured 0.87 on-chip); resnet18 from
+    # scratch needs the standard CIFAR-ResNet recipe — at the demo's tiny
+    # lr 5e-3 it sat at chance after its 512-step budget (attempts.jsonl),
+    # which is under-training, not divergence.
     arms = {
         "netresdeep": _arm_argv(
             "netresdeep", "netresdeep", args.epochs,
-            recipe + ["--n-chans1", "16", "--n-blocks", "2"],
+            ["--lr", "0.005", "--sync-bn", "--momentum", "0.9",
+             "--weight-decay", "5e-4",
+             "--n-chans1", "16", "--n-blocks", "2"],
         ),
-        "resnet18": _arm_argv("resnet18", "resnet18", args.epochs, recipe),
+        "resnet18": _arm_argv(
+            "resnet18", "resnet18", args.epochs,
+            ["--lr", "0.1", "--sync-bn", "--momentum", "0.9",
+             "--weight-decay", "5e-4"],
+        ),
     }
 
+    # Merge over any prior summary: a partial rerun (--arms resnet18) must
+    # extend the committed artifact, not clobber the other arm's leg.
     summary = {"device_probe": info, "epochs": args.epochs, "arms": {}}
     curves = {}
+    try:
+        with open(os.path.join(_OUT_DIR, "summary.json")) as f:
+            prior = json.load(f)
+        summary["arms"] = prior.get("arms", {})
+        for name, leg in summary["arms"].items():
+            if leg.get("accuracy_curve"):
+                curves[name] = leg["accuracy_curve"]
+    except (OSError, json.JSONDecodeError):
+        pass
     for name in [a.strip() for a in args.arms.split(",") if a.strip()]:
         if name not in arms:
             print(f"tpu_curve: unknown arm {name!r}, skipping", flush=True)
             continue
         print(f"tpu_curve: arm {name} starting", flush=True)
         jsonl = os.path.join(_OUT_DIR, f"{name}.jsonl")
-        if os.path.exists(jsonl):
-            os.unlink(jsonl)  # MetricLogger appends; a retry must not
+        jsonl_new = jsonl + ".new"
+        if os.path.exists(jsonl_new):
+            os.unlink(jsonl_new)  # MetricLogger appends; a retry must not
             # concatenate two runs into one committed curve
         result, err, wall = _run_arm(name, arms[name], args.arm_timeout)
         _record(f"tpu_curve_{name}", wall_s=round(wall, 1), error=err,
                 result=result)
-        curve = _curve(os.path.join(_OUT_DIR, f"{name}.jsonl"))
-        summary["arms"][name] = {
-            "result": result, "error": err, "wall_s": round(wall, 1),
-            "accuracy_curve": curve,
-        }
-        if curve:
-            curves[name] = curve
+        if result is not None:
+            os.replace(jsonl_new, jsonl)  # promote over the prior curve
+            curve = _curve(jsonl)
+            summary["arms"][name] = {
+                "result": result, "error": None, "wall_s": round(wall, 1),
+                "epochs": len(curve),  # partial reruns may use another
+                "global_batch": _GLOBAL_BATCH,  # horizon than the summary's
+                "accuracy_curve": curve,
+            }
+            if curve:
+                curves[name] = curve
+        else:
+            # failed rerun: keep the prior committed leg/jsonl/curve
+            # untouched; note the failure on the side
+            summary["arms"].setdefault(name, {"accuracy_curve": []})[
+                "last_error"] = err
+            if os.path.exists(jsonl_new):
+                os.unlink(jsonl_new)
         print(f"tpu_curve: arm {name} -> {'ok' if result else err} "
               f"[{wall:.0f}s]", flush=True)
         # summary is written after every arm: a TERM mid-run keeps legs
@@ -163,10 +206,11 @@ def main() -> None:
             "from tpu_ddp.metrics.plotting import plot_loss_curves; "
             "plot_loss_curves(json.loads({curves!r}), {png!r}, "
             "ylabel='test accuracy', "
-            "title='hard synthetic task on {kind} (batch 32, seed 0)')"
+            "title='hard synthetic task on {kind} "
+            "(global batch {gb}, seed 0)')"
         ).format(repo=_REPO, curves=json.dumps(curves),
                  png=os.path.join(_OUT_DIR, "accuracy_curves.png"),
-                 kind=info.get("kind", "tpu"))
+                 kind=info.get("kind", "tpu"), gb=_GLOBAL_BATCH)
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"

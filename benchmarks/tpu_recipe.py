@@ -72,6 +72,11 @@ def main() -> None:
                     "--compilation-cache-dir /tmp/tpu_ddp_xla_cache",
         "--size", "4096",
         "--epochs", str(args.epochs),
+        # GLOBAL batch 256 on the single chip = the committed CPU
+        # artifact's global batch (32/shard x 8 virtual workers), so both
+        # arms' lrs stay in the regime they were tuned/compared at; the
+        # demo's --batch-size is per-shard (reference semantics).
+        "--batch-size", "256",
         "--seeds", *args.seeds.split(),
     ]
     # A stale summary from an earlier run must not be read back as THIS
