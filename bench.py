@@ -154,33 +154,31 @@ def _measure(step, state, batch, *, target_seconds=8.0, max_calls=50):
     return state, calls, elapsed
 
 
-def _bench_flagship(quick: bool) -> dict:
+def _scan_point(
+    model, tx, *, steps_per_call: int, per_shard: int, seed: int = 0,
+    target_seconds: float = 8.0, max_calls: int = 50,
+) -> dict:
+    """ONE scan-fused measurement point (K optimizer steps per dispatch on
+    32x32 inputs): the single implementation of the K-stacked batch build
+    and the K-aware rate math, shared by the flagship leg and the fused
+    compute leg so their 'same measurement discipline' is code, not a
+    hand-kept convention."""
     import jax
     import numpy as np
 
     from tpu_ddp.data import synthetic_cifar10
     from tpu_ddp.metrics.mfu import compiled_flops, mfu
-    from tpu_ddp.models import NetResDeep
     from tpu_ddp.parallel import MeshSpec, create_mesh, stacked_batch_sharding
-    from tpu_ddp.train import (
-        create_train_state,
-        make_optimizer,
-        make_scan_train_step,
-    )
+    from tpu_ddp.train import create_train_state, make_scan_train_step
 
     devices = jax.devices()
     n_chips = len(devices)
     mesh = create_mesh(MeshSpec(data=-1), devices)
-
-    model = NetResDeep()
-    tx = make_optimizer(lr=1e-2)
     state = create_train_state(model, tx, jax.random.key(0))
-    steps_per_call = 4 if quick else 32
     step = make_scan_train_step(model, tx, mesh, steps_per_call=steps_per_call)
 
-    per_shard = 32
     global_batch = per_shard * n_chips
-    imgs, labels = synthetic_cifar10(steps_per_call * global_batch, seed=0)
+    imgs, labels = synthetic_cifar10(steps_per_call * global_batch, seed=seed)
     batch = {
         "image": imgs.astype(np.float32).reshape(
             steps_per_call, global_batch, 32, 32, 3
@@ -193,19 +191,44 @@ def _bench_flagship(quick: bool) -> dict:
     flops_per_call = compiled_flops(step, state, batch)
     _, calls, elapsed = _measure(
         step, state, batch,
-        target_seconds=2.0 if quick else 8.0,
-        max_calls=3 if quick else 50,
+        target_seconds=target_seconds, max_calls=max_calls,
     )
     per_chip = calls * steps_per_call * global_batch / elapsed / n_chips
     return {
         "images_per_sec_per_chip": round(per_chip, 1),
         "mfu": mfu(flops_per_call, calls / elapsed),
-        "model": "netresdeep",
-        "dtype": "float32",
         "per_shard_batch": per_shard,
         "steps_per_call": steps_per_call,
         "n_chips": n_chips,
     }
+
+
+def _bench_flagship(quick: bool) -> dict:
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.train import make_optimizer
+
+    point = _scan_point(
+        NetResDeep(), make_optimizer(lr=1e-2),
+        steps_per_call=4 if quick else 32, per_shard=32, seed=0,
+        target_seconds=2.0 if quick else 8.0,
+        max_calls=3 if quick else 50,
+    )
+    return {"model": "netresdeep", "dtype": "float32", **point}
+
+
+def _bench_flagship_point(steps_per_call: int, per_shard: int) -> dict:
+    """ONE flagship fusion-grid row at the given (K, per-shard) point — the
+    dispatch-amortization sweep unit, invoked leg-by-leg from the capture
+    tool so each child compiles exactly one program."""
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.train import make_optimizer
+
+    point = _scan_point(
+        NetResDeep(), make_optimizer(lr=1e-2),
+        steps_per_call=steps_per_call, per_shard=per_shard, seed=0,
+        target_seconds=6.0,
+    )
+    return {"model": "netresdeep", "dtype": "float32", **point}
 
 
 def _bench_dispatch_baseline() -> dict:
@@ -309,63 +332,113 @@ def _bench_vit_compute() -> dict:
     matmul-dominated compute leg. ResNet-50 on 32x32 CIFAR leaves the MXU
     under-tiled by tiny spatial maps; this is the config that shows what
     the framework's train step does when the FLOPs are MXU-shaped."""
+    import jax.numpy as jnp
+
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+    from tpu_ddp.train import make_optimizer
+
+    model = MODEL_REGISTRY["vit_b16"](num_classes=1000, dtype=jnp.bfloat16)
+    point = _image224_point(
+        model, make_optimizer(lr=1e-3, momentum=0.9),
+        num_classes=1000, per_shard=64, seed=3, max_calls=30,
+    )
+    return {"model": "vit_b16", "dtype": "bfloat16", **point}
+
+
+def _bench_compute_point(per_shard: int) -> dict:
+    """ONE ResNet-50 bf16 row at the given per-shard batch — the
+    batch-sweep unit invoked leg-by-leg from the capture tool (one fresh
+    XLA compile per child process; a monolithic two-point sweep leg burned
+    a whole 900s chip window on its second compile)."""
+    return {
+        "model": "resnet50", "dtype": "bfloat16",
+        **_resnet50_bf16_point(per_shard),
+    }
+
+
+def _bench_compute_fused() -> dict:
+    """Scan-fused variant of the headline config: K optimizer steps per
+    dispatch on ResNet-50 bf16 CIFAR (per-shard 256). The headline leg pays
+    one host dispatch per ~29 ms step; this measures what fusing K=8 steps
+    recovers — the tuned configuration the trainer's --steps-per-call flag
+    exposes for the compute-bound family, with the same measurement
+    discipline as the headline (same optimizer knobs, same seed)."""
+    import jax.numpy as jnp
+
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+    from tpu_ddp.train import make_optimizer
+
+    model = MODEL_REGISTRY["resnet50"](num_classes=10, dtype=jnp.bfloat16)
+    point = _scan_point(
+        model, make_optimizer(lr=1e-1, momentum=0.9),
+        steps_per_call=8, per_shard=256, seed=1, max_calls=20,
+    )
+    return {"model": "resnet50", "dtype": "bfloat16", **point}
+
+
+def _image224_point(model, tx, *, num_classes: int, per_shard: int,
+                    seed: int, max_calls: int) -> dict:
+    """ONE unfused 224x224 measurement point: the single implementation of
+    the ImageNet-shape batch build and rate math shared by the ViT and
+    ResNet-50 compute-capability legs."""
     import jax
     import numpy as np
 
     from tpu_ddp.metrics.mfu import compiled_flops, mfu
-    from tpu_ddp.models.zoo import MODEL_REGISTRY
     from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
-    from tpu_ddp.train import create_train_state, make_optimizer, make_train_step
+    from tpu_ddp.train import create_train_state, make_train_step
 
     devices = jax.devices()
     n_chips = len(devices)
     mesh = create_mesh(MeshSpec(data=-1), devices)
-
-    model = MODEL_REGISTRY["vit_b16"](
-        num_classes=1000, dtype=jax.numpy.bfloat16
-    )
-    tx = make_optimizer(lr=1e-3, momentum=0.9)
     state = create_train_state(
         model, tx, jax.random.key(0), input_shape=(1, 224, 224, 3)
     )
     step = make_train_step(model, tx, mesh)
 
-    per_shard = 64
     global_batch = per_shard * n_chips
-    rng = np.random.default_rng(3)
+    rng = np.random.default_rng(seed)
     batch = {
         "image": rng.standard_normal(
             (global_batch, 224, 224, 3), dtype=np.float32),
-        "label": rng.integers(0, 1000, global_batch),
+        "label": rng.integers(0, num_classes, global_batch),
         "mask": np.ones(global_batch, bool),
     }
     batch = jax.device_put(batch, batch_sharding(mesh))
 
     flops_per_call = compiled_flops(step, state, batch)
-    _, calls, elapsed = _measure(step, state, batch, max_calls=30)
+    _, calls, elapsed = _measure(step, state, batch, max_calls=max_calls)
     per_chip = calls * global_batch / elapsed / n_chips
     return {
         "images_per_sec_per_chip": round(per_chip, 1),
         "mfu": mfu(flops_per_call, calls / elapsed),
-        "model": "vit_b16",
-        "dtype": "bfloat16",
         "image_size": 224,
         "per_shard_batch": per_shard,
         "n_chips": n_chips,
     }
 
 
-def _bench_compute_sweep() -> dict:
-    """Per-shard batch sweep around the committed ResNet-50 bf16 point:
-    does more batch buy MFU on this chip, or is 256 already saturated?
-    Each point is a fresh `_resnet50_bf16_point` call (fresh state per
-    point — the jitted step donates its input state, so reusing one state
-    across points would reference deleted buffers)."""
-    points = [
-        _resnet50_bf16_point(per_shard)  # max_calls identical to the
-        for per_shard in (128, 512)      # headline leg; 256 is committed
-    ]
-    return {"model": "resnet50", "dtype": "bfloat16", "points": points}
+def _bench_resnet50_imagenet() -> dict:
+    """ResNet-50 bf16 at 224x224 with the ImageNet stem (7x7/2 + max-pool):
+    BASELINE.md item 4's scale-out config ("multi-host v4-32 ResNet-50
+    ImageNet"), measured per-chip. CIFAR's 32x32 maps under-tile the MXU
+    (the committed headline's known ceiling); at 224x224 the conv tiles are
+    MXU-shaped, so this row is the framework's conv compute capability the
+    way `vit_compute` is its matmul capability. Synthetic images — this
+    measures the train step, not a dataset."""
+    import jax.numpy as jnp
+
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+    from tpu_ddp.train import make_optimizer
+
+    model = MODEL_REGISTRY["resnet50"](
+        num_classes=1000, cifar_stem=False, dtype=jnp.bfloat16
+    )
+    point = _image224_point(
+        model, make_optimizer(lr=1e-1, momentum=0.9),
+        num_classes=1000, per_shard=64, seed=5, max_calls=30,
+    )
+    return {"model": "resnet50", "dtype": "bfloat16", **point}
 
 
 def _bench_attention() -> dict:

@@ -51,46 +51,29 @@ _LEG_CODE = {
                     "bench._attention_op_microbench()))",
     "vit_compute": "import bench; print(__import__('json').dumps("
                    "bench._bench_vit_compute()))",
-    "compute_sweep": "import bench; print(__import__('json').dumps("
-                     "bench._bench_compute_sweep()))",
-    # Tuning sweep for the flagship: how far does scan-fusion amortize the
-    # per-dispatch cost on the real chip? Reports img/s/chip per
-    # (steps_per_call, per_shard_batch) point; the best point is the
-    # framework's recommended flagship config.
-    "sweep": """
-import json
-import jax, numpy as np
-from tpu_ddp.data import synthetic_cifar10
-from tpu_ddp.models import NetResDeep
-from tpu_ddp.parallel import MeshSpec, create_mesh, stacked_batch_sharding
-from tpu_ddp.train import create_train_state, make_optimizer, make_scan_train_step
-import bench
-
-mesh = create_mesh(MeshSpec(data=-1), jax.devices())
-n = len(jax.devices())
-model, tx = NetResDeep(), make_optimizer(lr=1e-2)
-points = []
-for K in (32, 128):
-    for per_shard in (32, 256):
-        state = create_train_state(model, tx, jax.random.key(0))
-        step = make_scan_train_step(model, tx, mesh, steps_per_call=K)
-        gb = per_shard * n
-        imgs, labels = synthetic_cifar10(K * gb, seed=0)
-        batch = {
-            'image': imgs.astype(np.float32).reshape(K, gb, 32, 32, 3),
-            'label': labels.reshape(K, gb),
-            'mask': np.ones((K, gb), bool),
-        }
-        batch = jax.device_put(batch, stacked_batch_sharding(mesh))
-        _, calls, elapsed = bench._measure(
-            step, state, batch, target_seconds=6.0, max_calls=50)
-        rate = round(calls * K * gb / elapsed / n, 1)
-        points.append({'steps_per_call': K, 'per_shard_batch': per_shard,
-                       'images_per_sec_per_chip': rate})
-        print(json.dumps(points[-1]))
-best = max(points, key=lambda p: p['images_per_sec_per_chip'])
-print(json.dumps({'points': points, 'best': best}))
-""",
+    # The batch sweep runs point-by-point: ONE fresh XLA compile per leg
+    # child. (A monolithic two-point sweep leg burned a 900s window on its
+    # second compile over the tunneled runtime — never bundle two compiles
+    # into one child; the leg was deleted, not just deprecated.)
+    "compute_b128": "import bench; print(__import__('json').dumps("
+                    "bench._bench_compute_point(128)))",
+    "compute_b512": "import bench; print(__import__('json').dumps("
+                    "bench._bench_compute_point(512)))",
+    "compute_fused": "import bench; print(__import__('json').dumps("
+                     "bench._bench_compute_fused()))",
+    "compute_imagenet": "import bench; print(__import__('json').dumps("
+                        "bench._bench_resnet50_imagenet()))",
+    # Flagship fusion-grid points: how far does scan-fusion amortize the
+    # per-dispatch cost on the real chip? One (K, per_shard) point — one
+    # compile — per leg child. (The committed doc's "sweep" key holds the
+    # full 2x2 grid from the round-4 monolithic run; these per-point legs
+    # are the one-compile-per-child replacement for fresh docs.)
+    "sweep_k32_b256": "import bench; print(__import__('json').dumps("
+                      "bench._bench_flagship_point(32, 256)))",
+    "sweep_k128_b32": "import bench; print(__import__('json').dumps("
+                      "bench._bench_flagship_point(128, 32)))",
+    "sweep_k128_b256": "import bench; print(__import__('json').dumps("
+                       "bench._bench_flagship_point(128, 256)))",
 }
 
 _PRELUDE = (

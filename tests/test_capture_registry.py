@@ -1,0 +1,51 @@
+"""Fast guards over the on-chip capture tooling's leg registry.
+
+Deliberately NOT in test_bench_driver.py: that module is blanket-marked
+``slow`` (subprocess-heavy), but these checks are stdlib-only and must run
+in the default ``make test`` loop — a renamed bench leg has to fail here,
+between commits, not as a burned chip window (each capture leg child costs
+a pool grant plus an XLA compile).
+"""
+
+import importlib.util
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import bench  # noqa: E402  (stdlib-only at module level)
+
+
+def _load_capture_tpu():
+    spec = importlib.util.spec_from_file_location(
+        "capture_tpu", os.path.join(_REPO, "benchmarks", "capture_tpu.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_capture_legs_reference_real_bench_functions():
+    mod = _load_capture_tpu()
+    assert mod._LEG_CODE, "leg registry empty"
+    for leg, code in mod._LEG_CODE.items():
+        fns = re.findall(r"bench\.(_\w+)\(", code)
+        assert fns, f"leg {leg!r} calls no bench function"
+        for fn in fns:
+            assert callable(getattr(bench, fn, None)), (
+                f"leg {leg!r} references missing bench.{fn}")
+
+
+def test_capture_loop_targets_are_registered_legs():
+    """Every leg name the retry loop can request must exist in _LEG_CODE —
+    a stale name would make capture_tpu skip it every iteration, silently
+    idling the loop for its whole deadline."""
+    mod = _load_capture_tpu()
+    sh = open(os.path.join(_REPO, "benchmarks", "capture_loop.sh")).read()
+    m = re.search(r"legs = \(([^)]*)\)", sh)
+    assert m, "capture_loop.sh lost its legs tuple"
+    targets = re.findall(r'"(\w+)"', m.group(1))
+    assert targets, "no target legs parsed from capture_loop.sh"
+    unknown = [t for t in targets if t not in mod._LEG_CODE]
+    assert not unknown, f"capture_loop.sh requests unregistered legs: {unknown}"
