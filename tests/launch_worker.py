@@ -1,0 +1,45 @@
+"""Worker for the tpu-ddp-launch end-to-end test (spawned by the launcher
+in ``test_launch.py``, not collected by pytest).
+
+Unlike tests/multihost_worker.py (which passes rendezvous args explicitly),
+this worker receives NOTHING on argv: it must find the rendezvous purely
+from the TPU_DDP_* environment the launcher set — exercising the exact
+auto-join path the train CLI uses (``initialize_distributed()`` with no
+args at cli/train.py).
+
+Prints ``LAUNCH_OK pid=<process_id> n=<process_count>`` after a real
+cross-process barrier, so the parent can assert both ranks joined one job.
+"""
+
+import os
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tpu_ddp.parallel.runtime import initialize_distributed
+
+    initialize_distributed()  # no args: must read the launcher's env
+
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("launch_worker_barrier")
+    assert jax.device_count() == 2 * jax.process_count(), (
+        jax.device_count(), jax.process_count())
+    # single-node job: local rank IS the global process index
+    local_rank = os.environ["TPU_DDP_LOCAL_RANK"]
+    assert int(local_rank) == jax.process_index()
+    print(f"LAUNCH_OK pid={jax.process_index()} n={jax.process_count()}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,7 @@ torch.multiprocessing equivalent, by design (SURVEY.md §2.6).
 from __future__ import annotations
 
 import logging
+import os
 from typing import Optional
 
 import jax
@@ -30,13 +31,38 @@ def initialize_distributed(
     which *requires* its rendezvous even for one machine, main.py:22-24).
 
     On multi-host TPU pods pass ``force=True`` (args are auto-detected from
-    pod metadata) or give explicit coordinator args. With neither, this is a
-    no-op that does NOT touch any backend — platform selection may not have
-    happened yet, and forcing backend creation here would pin the wrong one.
+    pod metadata) or give explicit coordinator args. Processes spawned by
+    ``tpu-ddp-launch`` (the torchrun/mp.spawn equivalent, cli/launch.py)
+    carry the rendezvous triple in TPU_DDP_COORDINATOR / _NUM_PROCESSES /
+    _PROCESS_ID environment variables and auto-join here. With none of
+    these, this is a no-op that does NOT touch any backend — platform
+    selection may not have happened yet, and forcing backend creation here
+    would pin the wrong one.
     """
     global _initialized
     if _initialized:
         return
+    if coordinator_address is None and num_processes is None and not force:
+        # launcher-provided rendezvous (lazy import: cli.launch is
+        # stdlib-only, so this cannot recurse into backend setup)
+        from tpu_ddp.cli.launch import (
+            COORDINATOR_ENV,
+            NUM_PROCESSES_ENV,
+            PROCESS_ID_ENV,
+        )
+
+        coordinator_address = os.environ.get(COORDINATOR_ENV)
+        if coordinator_address is not None:
+            try:
+                num_processes = int(os.environ[NUM_PROCESSES_ENV])
+                process_id = int(os.environ[PROCESS_ID_ENV])
+            except (KeyError, ValueError) as e:
+                raise RuntimeError(
+                    f"{COORDINATOR_ENV} is set but its companions "
+                    f"{NUM_PROCESSES_ENV}/{PROCESS_ID_ENV} are missing or "
+                    f"non-integer — a partially scrubbed launcher "
+                    f"environment: {e}"
+                ) from e
     if coordinator_address is None and num_processes is None and not force:
         _initialized = True
         return
