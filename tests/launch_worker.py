@@ -34,9 +34,11 @@ def main() -> None:
     multihost_utils.sync_global_devices("launch_worker_barrier")
     assert jax.device_count() == 2 * jax.process_count(), (
         jax.device_count(), jax.process_count())
-    # single-node job: local rank IS the global process index
-    local_rank = os.environ["TPU_DDP_LOCAL_RANK"]
-    assert int(local_rank) == jax.process_index()
+    # dense node-major ranks: local rank == global index mod node width
+    local_rank = int(os.environ["TPU_DDP_LOCAL_RANK"])
+    nproc = int(os.environ["TPU_DDP_NPROC_PER_NODE"])
+    assert local_rank == jax.process_index() % nproc, (
+        local_rank, jax.process_index(), nproc)
     print(f"LAUNCH_OK pid={jax.process_index()} n={jax.process_count()}",
           flush=True)
 

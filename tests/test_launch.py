@@ -11,6 +11,7 @@ import time
 import pytest
 
 from tpu_ddp.cli.launch import (
+    NPROC_PER_NODE_ENV,
     COORDINATOR_ENV,
     LOCAL_RANK_ENV,
     NUM_PROCESSES_ENV,
@@ -44,12 +45,13 @@ def test_plan_ranks_rejects_bad_shapes():
 
 def test_child_env_sets_rendezvous_triple_and_local_rank():
     env = child_env({"KEEP": "1"}, coordinator="h:1234", num_processes=8,
-                    process_id=5, local_rank=1)
+                    process_id=5, local_rank=1, nproc_per_node=4)
     assert env["KEEP"] == "1"
     assert env[COORDINATOR_ENV] == "h:1234"
     assert env[NUM_PROCESSES_ENV] == "8"
     assert env[PROCESS_ID_ENV] == "5"
     assert env[LOCAL_RANK_ENV] == "1"
+    assert env[NPROC_PER_NODE_ENV] == "4"
 
 
 def test_multinode_requires_explicit_coordinator():
@@ -170,6 +172,36 @@ def test_forwarded_sigterm_wedged_rank_is_escalated_to_kill(tmp_path):
 
 
 # ------------------------------------------------------------- e2e (jax) --
+
+@pytest.mark.slow
+def test_launch_two_node_emulation(tmp_path):
+    """Multi-node shape: TWO launcher instances (node-rank 0 and 1) share
+    an explicit coordinator, each contributing one local process — the
+    exact command pattern a 2-host pod uses, emulated on localhost."""
+    from tpu_ddp.parallel.runtime import scrubbed_cpu_env
+
+    env = scrubbed_cpu_env()
+    env.pop("TPU_DDP_COORDINATOR", None)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    port = pick_free_port()
+    outs = [tmp_path / "node0.txt", tmp_path / "node1.txt"]
+    nodes = []
+    for rank, out in enumerate(outs):
+        nodes.append(subprocess.Popen(
+            [sys.executable, "-m", "tpu_ddp.cli.launch",
+             "--nnodes", "2", "--node-rank", str(rank),
+             "--coordinator", f"127.0.0.1:{port}", "--",
+             sys.executable,
+             os.path.join(_REPO, "tests", "launch_worker.py")],
+            env=env, stdout=open(out, "w"), stderr=subprocess.STDOUT,
+            cwd=_REPO,
+        ))
+    for rank, (node, out) in enumerate(zip(nodes, outs)):
+        assert node.wait(timeout=300) == 0, out.read_text()[-800:]
+    text = "".join(o.read_text() for o in outs)
+    assert "LAUNCH_OK pid=0 n=2" in text, text[-800:]
+    assert "LAUNCH_OK pid=1 n=2" in text, text[-800:]
+
 
 @pytest.mark.slow
 def test_launch_two_process_rendezvous_end_to_end(tmp_path):

@@ -47,6 +47,7 @@ COORDINATOR_ENV = "TPU_DDP_COORDINATOR"
 NUM_PROCESSES_ENV = "TPU_DDP_NUM_PROCESSES"
 PROCESS_ID_ENV = "TPU_DDP_PROCESS_ID"
 LOCAL_RANK_ENV = "TPU_DDP_LOCAL_RANK"
+NPROC_PER_NODE_ENV = "TPU_DDP_NPROC_PER_NODE"
 
 _TERM_GRACE_SECONDS = 15.0
 TERM_GRACE_ENV = "TPU_DDP_TERM_GRACE"
@@ -78,15 +79,18 @@ def plan_ranks(nnodes: int, nproc_per_node: int,
 
 
 def child_env(base: dict, *, coordinator: str, num_processes: int,
-              process_id: int, local_rank: int) -> dict:
+              process_id: int, local_rank: int,
+              nproc_per_node: int = 1) -> dict:
     """Environment for one launched process: the rendezvous triple that
-    ``initialize_distributed`` auto-joins, plus the local rank for
-    user-side per-process knobs (log prefixes, profiler dirs)."""
+    ``initialize_distributed`` auto-joins, plus the local rank and
+    node width for user-side per-process knobs (log prefixes, profiler
+    dirs, per-node device partitioning)."""
     env = dict(base)
     env[COORDINATOR_ENV] = coordinator
     env[NUM_PROCESSES_ENV] = str(num_processes)
     env[PROCESS_ID_ENV] = str(process_id)
     env[LOCAL_RANK_ENV] = str(local_rank)
+    env[NPROC_PER_NODE_ENV] = str(nproc_per_node)
     return env
 
 
@@ -154,7 +158,8 @@ def run_job(cmd: Sequence[str], *, nnodes: int = 1, nproc_per_node: int = 1,
                 list(cmd),
                 env=child_env(base_env, coordinator=coordinator,
                               num_processes=num_processes,
-                              process_id=process_id, local_rank=local_rank),
+                              process_id=process_id, local_rank=local_rank,
+                              nproc_per_node=nproc_per_node),
             ))
         rc = 0
         live = list(procs)
