@@ -104,10 +104,12 @@ def test_run_job_one_failed_rank_fails_the_job():
 
 
 _READY_PRELUDE = (
-    # each rank drops a sentinel once its handler is installed, so the
-    # test only signals a fully-started job (no startup race)
+    # each rank drops a sentinel AFTER its handler is installed (ready()
+    # must be called last in the body), so the test only signals a
+    # fully-armed job — touching before installing loses the race under
+    # load and the rank dies on the default TERM disposition
     "import os, pathlib, signal, sys, time;"
-    "pathlib.Path(os.environ['READY_DIR'], "
+    "ready = lambda: pathlib.Path(os.environ['READY_DIR'], "
     "os.environ['TPU_DDP_PROCESS_ID']).touch();"
 )
 
@@ -137,7 +139,7 @@ def test_forwarded_sigterm_clean_drain_exits_zero(tmp_path):
     Trainer's checkpoint-and-exit contract) -> the job reports success."""
     body = _READY_PRELUDE + (
         "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0));"
-        "time.sleep(60)"
+        "ready(); time.sleep(60)"
     )
     p = _launch_and_signal(body, tmp_path, grace="5")
     assert p.wait(timeout=30) == 0
@@ -150,7 +152,7 @@ def test_forwarded_sigterm_crashed_rank_fails_the_job(tmp_path):
     body = _READY_PRELUDE + (
         "code = 7 if os.environ['TPU_DDP_PROCESS_ID'] == '0' else 0;"
         "signal.signal(signal.SIGTERM, lambda *a: sys.exit(code));"
-        "time.sleep(60)"
+        "ready(); time.sleep(60)"
     )
     p = _launch_and_signal(body, tmp_path, grace="5")
     assert p.wait(timeout=30) == 7
@@ -162,7 +164,7 @@ def test_forwarded_sigterm_wedged_rank_is_escalated_to_kill(tmp_path):
     nonzero with the 128+signal convention."""
     body = _READY_PRELUDE + (
         "signal.signal(signal.SIGTERM, signal.SIG_IGN);"
-        "time.sleep(120)"
+        "ready(); time.sleep(120)"
     )
     t0 = time.monotonic()
     p = _launch_and_signal(body, tmp_path, grace="2")
