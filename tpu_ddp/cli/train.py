@@ -46,6 +46,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="clip the global gradient norm before the update "
                         "(0 = off); on DP the clip sees the synchronized "
                         "gradient, so replicas clip identically")
+    p.add_argument("--ema-decay", type=float, default=0.0,
+                   help="maintain an exponential moving average of the "
+                        "params (0 = off, typical 0.999); eval and "
+                        "predict use the averaged weights, and the EMA "
+                        "checkpoints/resumes inside the optimizer state")
     p.add_argument("--n-devices", type=int, default=None,
                    help="1 == the main_no_ddp.py single-device baseline")
     p.add_argument("--parallelism",
@@ -240,6 +245,7 @@ def config_from_args(args) -> TrainConfig:
         schedule=None if args.schedule == "constant" else args.schedule,
         warmup_steps=args.warmup_steps,
         grad_clip_norm=args.grad_clip_norm,
+        ema_decay=args.ema_decay,
         n_devices=n_devices,
         parallelism=args.parallelism,
         mesh=mesh_sizes,

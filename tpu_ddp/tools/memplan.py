@@ -47,7 +47,8 @@ _MODE_AXIS = {"tp": "model", "fsdp_tp": "model", "pp": "pipeline",
 
 def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
          remat: bool, topology: str, n_devices: int | None,
-         momentum: float = 0.9, image_size: int | None = None,
+         momentum: float = 0.9, ema_decay: float = 0.0,
+         image_size: int | None = None,
          num_classes: int | None = None,
          parallelism: str = "dp", axis_size: int | None = None) -> dict:
     """Compile the DP train step for ``topology`` and return the memory
@@ -82,7 +83,7 @@ def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
         return _plan_inner(
             model_name, per_shard_batch, compute_dtype=compute_dtype,
             remat=remat, topology=topology, n_devices=n_devices,
-            momentum=momentum, image_size=image_size,
+            momentum=momentum, ema_decay=ema_decay, image_size=image_size,
             num_classes=num_classes, parallelism=parallelism,
             axis_size=axis_size,
         )
@@ -91,8 +92,8 @@ def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
 
 
 def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
-                topology, n_devices, momentum, image_size, num_classes,
-                parallelism, axis_size):
+                topology, n_devices, momentum, ema_decay, image_size,
+                num_classes, parallelism, axis_size):
     import jax
 
     import jax.numpy as jnp
@@ -141,7 +142,9 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
     else:
         model = MODEL_REGISTRY[model_name](num_classes=num_classes,
                                            dtype=dtype)
-    tx = make_optimizer(lr=1e-1, momentum=momentum)
+    # ema_decay matters here exactly like momentum: each is a full
+    # param-sized optimizer-state tree of HBM the plan must count
+    tx = make_optimizer(lr=1e-1, momentum=momentum, ema_decay=ema_decay)
     state = jax.eval_shape(
         lambda: create_train_state(
             model, tx, jax.random.key(0),
@@ -321,6 +324,10 @@ def main(argv=None) -> dict:
                         "tp/fsdp_tp/pp/ep/sp (default: 2 for pp — vit_s4 "
                         "is depth 6 — else 4)")
     p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--ema-decay", type=float, default=0.0,
+                   help="plan with a parameter-EMA shadow (another "
+                        "param-sized opt_state tree; see --ema-decay on "
+                        "the train CLI)")
     p.add_argument("--topology", default="v5e:2x2",
                    help='deviceless slice, e.g. "v5e:2x2", "v5e:2x4"')
     p.add_argument("--n-devices", type=int, default=None,
@@ -334,7 +341,8 @@ def main(argv=None) -> dict:
     report = plan(
         args.model, args.batch_size, compute_dtype=args.compute_dtype,
         remat=args.remat, topology=args.topology, n_devices=args.n_devices,
-        momentum=args.momentum, image_size=args.image_size,
+        momentum=args.momentum, ema_decay=args.ema_decay,
+        image_size=args.image_size,
         num_classes=args.num_classes, parallelism=args.parallelism,
         axis_size=args.axis_size,
     )

@@ -10,9 +10,66 @@ freezes nothing; here freezing is an optax partition whose frozen side is
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Any, Callable, NamedTuple, Optional
 
 import optax
+
+
+class EmaState(NamedTuple):
+    """Shadow EMA of the post-update params, carried INSIDE opt_state so it
+    checkpoints with the rest of training state (orbax, `main.py:45`'s
+    torch.save analogue) and inherits the param shardings under ZeRO
+    (`parallel/partitioning.py::opt_state_specs` suffix-matches its leaves
+    to the param tree)."""
+
+    ema: Any
+
+
+def params_ema(decay: float) -> optax.GradientTransformation:
+    """Maintain ``ema = decay * ema + (1 - decay) * new_params`` each step.
+
+    Chained LAST in the optimizer so ``updates`` are final (lr-scaled,
+    clipped, frozen-masked) and the shadowed value is exactly the params
+    the step is about to produce. The transform passes updates through
+    unchanged — it only rides along to see them.
+    """
+    if not 0.0 < decay < 1.0:
+        raise ValueError(f"ema decay must be in (0, 1), got {decay}")
+    import jax
+
+    def init_fn(params):
+        # a REAL copy, not an alias: the train step donates its input
+        # TrainState, and an opt_state leaf aliasing a params buffer makes
+        # the executable receive the same buffer twice (donation error)
+        import jax.numpy as jnp
+
+        return EmaState(ema=jax.tree.map(lambda p: jnp.array(p), params))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("params_ema requires params")
+        # optax.apply_updates semantics: new = p + u (u already lr-scaled)
+        ema = jax.tree.map(
+            lambda e, p, u: decay * e + (1.0 - decay) * (p + u),
+            state.ema, params, updates,
+        )
+        return updates, EmaState(ema=ema)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def find_ema(opt_state: Any) -> Optional[Any]:
+    """The EMA param tree inside ``opt_state``, or None if the optimizer
+    was built without ``ema_decay`` — the eval-time accessor."""
+    import jax
+
+    found = [
+        leaf.ema
+        for leaf in jax.tree.leaves(
+            opt_state, is_leaf=lambda x: isinstance(x, EmaState))
+        if isinstance(leaf, EmaState)
+    ]
+    return found[0] if found else None
 
 
 def _decay_mask(params):
@@ -35,6 +92,7 @@ def make_optimizer(
     grad_clip_norm: float = 0.0,
     freeze_predicate: Optional[Callable[[tuple, object], bool]] = None,
     optimizer: str = "sgd",
+    ema_decay: float = 0.0,
 ) -> optax.GradientTransformation:
     """freeze_predicate(path_tuple, leaf) -> True to FREEZE that param.
     ``grad_clip_norm`` > 0 clips the GLOBAL gradient norm before the update
@@ -46,7 +104,12 @@ def make_optimizer(
     trains poorly under SGD-momentum), or ``lamb`` (layer-wise-adaptive
     large-global-batch training, the regime a data-parallel framework
     scales into). adamw/lamb decay decoupled-style inside the transform
-    with the same kernels-only mask sgd uses for its coupled decay."""
+    with the same kernels-only mask sgd uses for its coupled decay.
+
+    ``ema_decay`` > 0 maintains an exponential moving average of the
+    params inside opt_state (`EmaState`); the Trainer evaluates with the
+    averaged weights when enabled (``find_ema``) — the standard
+    late-training variance reduction the reference has no analogue for."""
     if grad_clip_norm < 0:
         raise ValueError(f"grad_clip_norm must be >= 0, got {grad_clip_norm}")
     if schedule == "cosine":
@@ -96,6 +159,10 @@ def make_optimizer(
         tx = optax.multi_transform(
             {"trainable": tx, "frozen": optax.set_to_zero()}, labeler
         )
+    if ema_decay:
+        # outermost-last so the shadow sees the FINAL updates (after lr,
+        # clip, decay, and any freeze masking)
+        tx = optax.chain(tx, params_ema(ema_decay))
     return tx
 
 

@@ -54,6 +54,9 @@ class TrainConfig:
     schedule: Optional[str] = None        # "cosine" | None
     warmup_steps: int = 0
     grad_clip_norm: float = 0.0           # 0 = off (global-norm clip)
+    ema_decay: float = 0.0                # >0: shadow EMA of params in
+                                          # opt_state; eval/predict use the
+                                          # averaged weights
     n_devices: Optional[int] = None       # None = all; 1 = main_no_ddp mode
     parallelism: Optional[str] = None     # dp|fsdp|tp|pp|sp|ep; None = infer
                                           # from mesh (default dp)
@@ -245,6 +248,7 @@ class Trainer:
             warmup_steps=config.warmup_steps,
             grad_clip_norm=config.grad_clip_norm,
             freeze_predicate=freeze,
+            ema_decay=config.ema_decay,
         )
         from tpu_ddp.train.losses import (
             binary_cross_entropy_with_logits,
@@ -900,6 +904,21 @@ class Trainer:
         achieved = (flops / steps_per_exec) * (steady_steps / steady_seconds)
         return achieved / peak_flops_per_chip()
 
+    def _eval_source_state(self):
+        """The state eval/predict should read weights from: the EMA shadow
+        when --ema-decay is on (the averaged weights are the ones an EMA
+        recipe deploys), re-laid-out by the strategy hook if one exists
+        (pp restacks params stage-major) — EMA swap happens FIRST so the
+        hook sees a params tree in its expected training layout."""
+        s = self.state
+        if self.config.ema_decay:
+            from tpu_ddp.train.optim import find_ema
+
+            ema = find_ema(s.opt_state)
+            if ema is not None:
+                s = s.replace(params=ema)
+        return self._prepare_eval(s) if self._prepare_eval else s
+
     def evaluate(self) -> tuple:
         """Test-set accuracy/loss — the eval loop the reference never had.
 
@@ -907,9 +926,7 @@ class Trainer:
         batch would force a host sync every dispatch and serialize the eval
         pipeline, exactly the stall the train loop avoids with its single
         epoch-end device_get."""
-        eval_state = (
-            self._prepare_eval(self.state) if self._prepare_eval else self.state
-        )
+        eval_state = self._eval_source_state()
         outs = [
             self.eval_step(eval_state, self._put(batch))
             for batch in self.test_loader.epoch_batches(epoch=0)
@@ -936,9 +953,7 @@ class Trainer:
         if self.predict_step is None:
             self.predict_step = make_predict_step(self.model, self.mesh)
         loader = loader if loader is not None else self.test_loader
-        pred_state = (
-            self._prepare_eval(self.state) if self._prepare_eval else self.state
-        )
+        pred_state = self._eval_source_state()
         logits_all, labels_all = [], []
         for batch in loader.epoch_batches(epoch=0):
             out = self.predict_step(pred_state, self._put(batch))
