@@ -1,0 +1,131 @@
+"""ensure_dataset: the download=True convenience, tested fully offline
+against local fake archives served over file:// URLs."""
+
+import hashlib
+import io
+import os
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+
+from tpu_ddp.data.cifar10 import load_cifar10
+from tpu_ddp.data.download import ensure_dataset
+
+
+def _fake_cifar10_tar(path):
+    """A structurally-real cifar-10-python.tar.gz (tiny): the loader must
+    be able to auto-extract and parse what ensure_dataset lands."""
+    rng = np.random.default_rng(0)
+
+    def batch(n):
+        return {
+            b"data": rng.integers(0, 256, (n, 3072), dtype=np.uint8),
+            b"labels": rng.integers(0, 10, n).tolist(),
+        }
+
+    with tarfile.open(path, "w:gz") as tf:
+        for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+            blob = pickle.dumps(batch(4))
+            info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+
+
+def _md5(path):
+    return hashlib.md5(open(path, "rb").read()).hexdigest()
+
+
+def test_download_fetches_verifies_and_loader_extracts(tmp_path):
+    src = tmp_path / "served" / "cifar-10-python.tar.gz"
+    src.parent.mkdir()
+    _fake_cifar10_tar(src)
+    data_dir = tmp_path / "data"
+    ensure_dataset(
+        str(data_dir), "cifar10", download=True,
+        url=src.as_uri(), md5=_md5(src),
+    )
+    assert (data_dir / "cifar-10-python.tar.gz").is_file()
+    imgs, labels = load_cifar10(str(data_dir), train=True)  # auto-extract
+    assert imgs.shape == (20, 32, 32, 3) and labels.shape == (20,)
+
+
+def test_download_rejects_checksum_mismatch(tmp_path):
+    src = tmp_path / "cifar-10-python.tar.gz"
+    _fake_cifar10_tar(src)
+    data_dir = tmp_path / "data"
+    with pytest.raises(IOError, match="checksum mismatch"):
+        ensure_dataset(
+            str(data_dir), "cifar10", download=True,
+            url=src.as_uri(), md5="0" * 32,
+        )
+    # nothing half-written left behind
+    assert not any(data_dir.glob("*.tar.gz*"))
+
+
+def test_noop_when_valid_tarball_already_present(tmp_path):
+    dest = tmp_path / "cifar-10-python.tar.gz"
+    _fake_cifar10_tar(dest)
+    before = dest.read_bytes()
+    # url intentionally bogus: a VERIFIED existing tarball short-circuits
+    ensure_dataset(str(tmp_path), "cifar10", download=True,
+                   url="file:///nonexistent", md5=_md5(dest))
+    assert dest.read_bytes() == before
+
+
+def test_corrupt_existing_tarball_is_refetched(tmp_path):
+    """torchvision semantics: a truncated/tampered pre-existing archive
+    must be re-downloaded, not handed to the loader to die in extractall."""
+    src = tmp_path / "served" / "cifar-10-python.tar.gz"
+    src.parent.mkdir()
+    _fake_cifar10_tar(src)
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    bad = data_dir / "cifar-10-python.tar.gz"
+    bad.write_bytes(src.read_bytes()[:100])  # interrupted copy
+    ensure_dataset(str(data_dir), "cifar10", download=True,
+                   url=src.as_uri(), md5=_md5(src))
+    assert _md5(bad) == _md5(src)  # replaced with the good bytes
+
+
+def test_noop_when_extracted_in_loader_candidate_layout(tmp_path):
+    """Presence probing must agree with the loader's candidate list: data
+    extracted at data_dir/CIFAR-10/cifar-10-batches-py (the default
+    --data-dir layout) short-circuits any fetch."""
+    src = tmp_path / "cifar-10-python.tar.gz"
+    _fake_cifar10_tar(src)
+    nested = tmp_path / "data" / "CIFAR-10"
+    nested.mkdir(parents=True)
+    with tarfile.open(src) as tf:
+        tf.extractall(nested, filter="data")
+    ensure_dataset(str(tmp_path / "data"), "cifar10", download=True,
+                   url="file:///nonexistent", md5="0" * 32)
+    assert not (tmp_path / "data" / "cifar-10-python.tar.gz").exists()
+
+
+def test_nonzero_local_rank_waits_for_rank_zero(tmp_path, monkeypatch):
+    """In a launched multi-process job only local rank 0 fetches; a
+    non-zero rank polls — and times out loudly if the artifact never
+    appears instead of racing a second download."""
+    monkeypatch.setenv("TPU_DDP_LOCAL_RANK", "1")
+    with pytest.raises(TimeoutError, match="local rank 1"):
+        ensure_dataset(str(tmp_path), "cifar10", download=True,
+                       url="file:///nonexistent", md5="0" * 32,
+                       wait_timeout=0.2)
+    # but an artifact already landed by rank 0 satisfies the wait
+    _fake_cifar10_tar(tmp_path / "cifar-10-python.tar.gz")
+    ensure_dataset(str(tmp_path), "cifar10", download=True,
+                   url="file:///nonexistent", md5="0" * 32,
+                   wait_timeout=5.0)
+
+
+def test_no_download_leaves_loader_error_intact(tmp_path):
+    ensure_dataset(str(tmp_path), "cifar10", download=False)
+    with pytest.raises(FileNotFoundError, match="download=False"):
+        load_cifar10(str(tmp_path), train=True)
+
+
+def test_unknown_dataset_rejected(tmp_path):
+    with pytest.raises(ValueError, match="unknown dataset"):
+        ensure_dataset(str(tmp_path), "imagenet", download=True)

@@ -20,6 +20,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cpu forces the XLA CPU backend; tpu/auto use the "
                         "platform JAX selected (BASELINE.json north star flag)")
     p.add_argument("--data-dir", default="data/CIFAR-10")
+    p.add_argument("--download", action="store_true",
+                   help="fetch + md5-verify the canonical dataset tarball "
+                        "into --data-dir when absent (the reference's "
+                        "datasets.CIFAR10 download=True convenience)")
     p.add_argument("--dataset", choices=["cifar10", "cifar100"], default="cifar10",
                    help="cifar100 = BASELINE.json configs[2] scale-out recipe "
                         "(set --num-classes 100)")
@@ -234,6 +238,7 @@ def config_from_args(args) -> TrainConfig:
         per_shard = args.global_batch_size // data
     return TrainConfig(
         data_dir=args.data_dir,
+        download=args.download,
         dataset=args.dataset,
         synthetic_data=args.synthetic_data,
         epochs=args.epochs,

@@ -28,6 +28,38 @@ _TEST_FILES = ["test_batch"]
 _C100_TRAIN_FILES = ["train"]
 _C100_TEST_FILES = ["test"]
 
+# (subdir, marker_files, tarball, what) per dataset — the single source of
+# on-disk layout truth, shared with data/download.py so acquisition and
+# loading can never disagree about where data lives.
+DATASET_LAYOUTS = {
+    "cifar10": ("cifar-10-batches-py", ["data_batch_1", "test_batch"],
+                "cifar-10-python.tar.gz", "CIFAR-10"),
+    "cifar100": ("cifar-100-python", ["train", "test"],
+                 "cifar-100-python.tar.gz", "CIFAR-100"),
+}
+
+
+def extracted_dataset_dir(data_dir: str, dataset: str):
+    """The extracted batches dir if present (the loader's own candidate
+    list), else None. Pure probe: never extracts, never raises."""
+    subdir, markers, _, what = DATASET_LAYOUTS[dataset]
+    for c in (data_dir, os.path.join(data_dir, subdir),
+              os.path.join(data_dir, what, subdir)):
+        if any(os.path.isfile(os.path.join(c, m)) for m in markers):
+            return c
+    return None
+
+
+def existing_tarball(data_dir: str, dataset: str):
+    """Path to an already-present canonical tarball (the loader's candidate
+    locations), else None."""
+    _, _, tarball, what = DATASET_LAYOUTS[dataset]
+    for c in (data_dir, os.path.join(data_dir, what)):
+        p = os.path.join(c, tarball)
+        if os.path.isfile(p):
+            return p
+    return None
+
 
 def _find_dataset_dir(
     data_dir: str, subdir: str, marker_files, tarball: str, what: str
@@ -46,7 +78,14 @@ def _find_dataset_dir(
         tar = os.path.join(c, tarball)
         if os.path.isfile(tar):
             with tarfile.open(tar) as tf:
-                tf.extractall(c)
+                try:
+                    # "data" filter: reject absolute paths / path traversal
+                    # (and silence the 3.14 default-change warning)
+                    tf.extractall(c, filter="data")
+                except TypeError:
+                    # filter= needs >=3.12 (backported to 3.10.12/3.11.4);
+                    # pyproject supports >=3.10
+                    tf.extractall(c)
             return os.path.join(c, subdir)
     raise FileNotFoundError(
         f"{what} batches not found under {data_dir!r} (download=False "
@@ -56,13 +95,7 @@ def _find_dataset_dir(
 
 
 def _find_batches_dir(data_dir: str) -> str:
-    return _find_dataset_dir(
-        data_dir,
-        "cifar-10-batches-py",
-        ["data_batch_1", "test_batch"],
-        "cifar-10-python.tar.gz",
-        "CIFAR-10",
-    )
+    return _find_dataset_dir(data_dir, *DATASET_LAYOUTS["cifar10"])
 
 
 def load_cifar10(data_dir: str, train: bool = True) -> Tuple[np.ndarray, np.ndarray]:
@@ -78,13 +111,7 @@ def load_cifar100(data_dir: str, train: bool = True) -> Tuple[np.ndarray, np.nda
     the scale-out dataset of BASELINE.json configs[2]. Same image layout and
     normalization constants as CIFAR-10 (close enough for training; swap via
     normalize() if exact per-dataset stats are wanted)."""
-    batches_dir = _find_dataset_dir(
-        data_dir,
-        "cifar-100-python",
-        ["train", "test"],
-        "cifar-100-python.tar.gz",
-        "CIFAR-100",
-    )
+    batches_dir = _find_dataset_dir(data_dir, *DATASET_LAYOUTS["cifar100"])
     return _load_pickles(
         batches_dir, _C100_TRAIN_FILES if train else _C100_TEST_FILES,
         b"fine_labels",

@@ -1,0 +1,146 @@
+"""Dataset fetch: the ``download=True`` convenience of the reference.
+
+The reference leans on torchvision for acquisition
+(``/root/reference/main.py:53``: ``datasets.CIFAR10(..., download=True)``);
+this framework's loader reads the raw pickle batches directly
+(``data/cifar10.py``), so the missing piece is getting the canonical
+tarball onto disk. ``ensure_dataset`` does exactly that, torchvision-style:
+
+- extracted batches already present (any of the loader's own candidate
+  locations, via ``cifar10.DATASET_LAYOUTS``) -> no-op;
+- a tarball already present -> MD5-verify it; a bad (truncated,
+  interrupted-copy) tarball is deleted and re-fetched rather than handed
+  to the loader to die in ``extractall``;
+- otherwise fetch (stdlib urllib), checksum, and land atomically via a
+  per-process temp + ``os.replace`` so concurrent callers can never
+  corrupt a verified file;
+- in a multi-process job (``tpu-ddp-launch``), only local rank 0 of each
+  host downloads; the other ranks poll for the verified artifact — one
+  170 MB fetch per host, not one per process.
+
+Offline environments (like this build's CI — zero egress) keep working:
+``download=False`` leaves the loader's clear pre-populate error intact,
+and the tests exercise the full path against local fakes via ``url=``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import time
+import urllib.request
+
+from tpu_ddp.data.cifar10 import (
+    DATASET_LAYOUTS,
+    existing_tarball,
+    extracted_dataset_dir,
+)
+
+log = logging.getLogger(__name__)
+
+_CANON = {
+    "cifar10": (
+        "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz",
+        "c58f30108f718f92721af3b95e74349a",
+    ),
+    "cifar100": (
+        "https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz",
+        "eb9058c3a382ffc7106e4002c42a8d85",
+    ),
+}
+
+
+def _md5(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _fetch(url: str, dest: str, md5: str) -> None:
+    """Download to a per-process temp, verify, land atomically. A unique
+    temp name means two racing processes each verify their OWN bytes and
+    the final os.replace is atomic either way — never a half-written or
+    interleaved dest."""
+    part = f"{dest}.part.{os.getpid()}"
+    try:
+        with urllib.request.urlopen(url) as r, open(part, "wb") as f:
+            while True:
+                b = r.read(1 << 20)
+                if not b:
+                    break
+                f.write(b)
+        got = _md5(part)
+        if got != md5:
+            raise IOError(
+                f"checksum mismatch for {url}: got {got}, want {md5} "
+                f"(truncated or tampered download; removed)"
+            )
+        os.replace(part, dest)
+    finally:
+        if os.path.exists(part):
+            os.remove(part)
+
+
+def ensure_dataset(
+    data_dir: str,
+    dataset: str = "cifar10",
+    *,
+    download: bool = False,
+    url: str | None = None,
+    md5: str | None = None,
+    wait_timeout: float = 900.0,
+) -> str:
+    """Make sure ``data_dir`` holds ``dataset``; return ``data_dir``.
+
+    See the module docstring for the exact semantics. ``url``/``md5``
+    override the canonical source (mirrors, tests). ``wait_timeout`` caps
+    how long a non-zero local rank waits for rank 0's download.
+    """
+    if dataset not in DATASET_LAYOUTS:
+        raise ValueError(
+            f"unknown dataset {dataset!r}; one of {list(DATASET_LAYOUTS)}")
+    default_url, default_md5 = _CANON[dataset]
+    url = url or default_url
+    md5 = md5 or default_md5
+    tarball = DATASET_LAYOUTS[dataset][2]
+
+    if extracted_dataset_dir(data_dir, dataset) is not None:
+        return data_dir
+
+    local_rank = int(os.environ.get("TPU_DDP_LOCAL_RANK", "0") or "0")
+    if download and local_rank != 0:
+        # one fetch per host: rank 0 owns the artifact (verify, delete,
+        # re-download); the other ranks only ever WAIT for it — a rank
+        # that deleted a tarball mid-verify would race rank 0's replace
+        deadline = time.monotonic() + wait_timeout
+        while time.monotonic() < deadline:
+            if (extracted_dataset_dir(data_dir, dataset) is not None
+                    or existing_tarball(data_dir, dataset) is not None):
+                return data_dir
+            time.sleep(1.0)
+        raise TimeoutError(
+            f"local rank {local_rank}: waited {wait_timeout:.0f}s for rank "
+            f"0's {tarball} download under {data_dir!r}"
+        )
+
+    have = existing_tarball(data_dir, dataset)
+    if have is not None:
+        if not download or _md5(have) == md5:
+            # download=False trusts what the user placed (the loader's
+            # pre-existing behavior); download=True verifies like
+            # torchvision and re-fetches a bad archive
+            return data_dir
+        log.warning("%s fails its checksum; re-downloading", have)
+        os.remove(have)
+    if not download:
+        return data_dir  # loader will raise its pre-populate error
+
+    os.makedirs(data_dir, exist_ok=True)
+    _fetch(url, os.path.join(data_dir, tarball), md5)
+    return data_dir

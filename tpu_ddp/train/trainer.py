@@ -36,6 +36,8 @@ class TrainConfig:
     script's argparse surface (SURVEY.md §5.6), as one dataclass."""
 
     data_dir: str = "data/CIFAR-10"      # main.py:19
+    download: bool = False                # fetch + md5-verify the canonical
+                                          # tarball when absent (main.py:53)
     dataset: str = "cifar10"              # cifar10 | cifar100
     synthetic_data: bool = False          # no torchvision download path
     synthetic_size: int = 2048
@@ -186,7 +188,11 @@ def load_dataset(c: TrainConfig):
             test = synthetic_cifar10(test_size, c.num_classes, c.seed + 1)
     else:
         from tpu_ddp.data.cifar10 import load_cifar10, load_cifar100
+        from tpu_ddp.data.download import ensure_dataset
 
+        # reference parity: datasets.CIFAR10(..., download=True),
+        # main.py:53 — no-op unless --download and the data is absent
+        ensure_dataset(c.data_dir, c.dataset, download=c.download)
         load = {"cifar10": load_cifar10, "cifar100": load_cifar100}[c.dataset]
         train = load(c.data_dir, train=True)
         test = load(c.data_dir, train=False)
