@@ -85,6 +85,60 @@ def test_ema_state_inherits_param_shardings():
     assert ema_specs["fc"]["kernel"] == P(None)
 
 
+def test_ema_updates_inside_scan_fused_step():
+    """The flagship config fuses K optimizer steps into one dispatch
+    (make_scan_train_step); the EMA shadow must advance once per INNER
+    step, not once per dispatch — K fused steps and K unfused steps from
+    the same start must produce the same shadow."""
+    from tpu_ddp.data import synthetic_cifar10
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.parallel import (
+        MeshSpec,
+        batch_sharding,
+        create_mesh,
+        stacked_batch_sharding,
+    )
+    from tpu_ddp.train import (
+        create_train_state,
+        make_scan_train_step,
+        make_train_step,
+    )
+
+    K, per_shard = 3, 4
+    mesh = create_mesh(MeshSpec(data=-1), jax.devices())
+    n = len(jax.devices())
+    gb = per_shard * n
+    model = NetResDeep(n_blocks=2)
+    tx = make_optimizer(lr=0.05, ema_decay=0.8)
+    imgs, labels = synthetic_cifar10(K * gb, seed=3)
+    imgs = imgs.astype(np.float32)
+
+    fused_state = create_train_state(model, tx, jax.random.key(0))
+    fused = make_scan_train_step(model, tx, mesh, steps_per_call=K,
+                                 donate=False)
+    batch_k = jax.device_put(
+        {"image": imgs.reshape(K, gb, 32, 32, 3),
+         "label": labels.reshape(K, gb),
+         "mask": np.ones((K, gb), bool)},
+        stacked_batch_sharding(mesh))
+    fused_state, _ = fused(fused_state, batch_k)
+
+    step_state = create_train_state(model, tx, jax.random.key(0))
+    step = make_train_step(model, tx, mesh, donate=False)
+    for k in range(K):
+        b = jax.device_put(
+            {"image": imgs[k * gb:(k + 1) * gb],
+             "label": labels[k * gb:(k + 1) * gb],
+             "mask": np.ones(gb, bool)},
+            batch_sharding(mesh))
+        step_state, _ = step(step_state, b)
+
+    ema_fused = find_ema(fused_state.opt_state)
+    ema_step = find_ema(step_state.opt_state)
+    for a, b in zip(jax.tree.leaves(ema_fused), jax.tree.leaves(ema_step)):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
 def test_trainer_ema_eval_and_resume(tmp_path):
     """End-to-end: train with --ema-decay, eval reads the EMA weights, and
     a checkpoint round-trip preserves the shadow exactly."""
