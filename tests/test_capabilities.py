@@ -171,6 +171,25 @@ def test_plotting_writes_png(tmp_path):
     assert os.path.getsize(out2) > 1000
 
 
+def test_metric_logger_tensorboard_sink(tmp_path):
+    """--tensorboard-dir writes real TB event files next to JSONL (SURVEY
+    §5.5's planned sink); non-numeric scalars are skipped, not crashed on."""
+    from tpu_ddp.metrics.logging import MetricLogger
+
+    logger = MetricLogger(
+        jsonl_path=str(tmp_path / "m.jsonl"),
+        tensorboard_dir=str(tmp_path / "tb"),
+        stdout=False,
+    )
+    logger.log(1, loss=2.0, accuracy=0.1, note="text-skipped")
+    logger.log(2, loss=1.0, accuracy=0.4)
+    logger.close()
+    events = list((tmp_path / "tb").glob("events.out.tfevents.*"))
+    assert events and events[0].stat().st_size > 0
+    lines = open(tmp_path / "m.jsonl").read().strip().splitlines()
+    assert len(lines) == 2  # JSONL sink unaffected
+
+
 def test_synthetic_multilabel_shapes():
     imgs, targets = synthetic_multilabel(32, num_classes=3)
     assert imgs.shape == (32, 32, 32, 3)

@@ -132,6 +132,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every-epochs", type=int, default=10)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--jsonl", default=None, help="metrics JSONL path")
+    p.add_argument("--tensorboard-dir", default=None,
+                   help="write TensorBoard scalar events here "
+                        "(process-0 only), alongside --jsonl")
     p.add_argument("--profile-dir", default=None,
                    help="emit an XLA/TPU profiler trace (TensorBoard/"
                         "Perfetto) for one steady-state epoch")
@@ -281,6 +284,7 @@ def config_from_args(args) -> TrainConfig:
         checkpoint_every_epochs=args.checkpoint_every_epochs,
         resume=args.resume,
         jsonl_path=args.jsonl,
+        tensorboard_dir=args.tensorboard_dir,
         profile_dir=args.profile_dir,
         freeze_prefixes=tuple(args.freeze) if args.freeze else None,
         loss=args.loss,

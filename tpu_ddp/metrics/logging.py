@@ -11,15 +11,32 @@ from tpu_ddp.parallel.runtime import is_primary_process
 
 
 class MetricLogger:
-    """Scalars -> stdout (+ optional JSONL file). Process-0 gated, fixing the
-    reference's every-rank-prints interleaving (``main.py:44,49``)."""
+    """Scalars -> stdout (+ optional JSONL file, + optional TensorBoard
+    event files). Process-0 gated, fixing the reference's every-rank-prints
+    interleaving (``main.py:44,49``).
 
-    def __init__(self, jsonl_path: Optional[str] = None, stdout: bool = True):
+    TensorBoard (SURVEY.md §5.5's planned sink, next to JSONL) uses
+    ``torch.utils.tensorboard`` — torch is CPU-only in this stack and the
+    writer is pure host-side IO, so no accelerator coupling. Lazily
+    imported: environments without torch still run with JSONL/stdout."""
+
+    def __init__(self, jsonl_path: Optional[str] = None, stdout: bool = True,
+                 tensorboard_dir: Optional[str] = None):
         self.stdout = stdout
         self._fh = None
+        self._tb = None
         if jsonl_path and is_primary_process():
             os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
             self._fh = open(jsonl_path, "a", buffering=1)
+        if tensorboard_dir and is_primary_process():
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+            except ImportError as e:
+                raise ImportError(
+                    "--tensorboard-dir needs torch's SummaryWriter; "
+                    "use --jsonl in environments without torch"
+                ) from e
+            self._tb = SummaryWriter(tensorboard_dir)
 
     def log(self, step: int, **scalars) -> None:
         if not is_primary_process():
@@ -33,6 +50,10 @@ class MetricLogger:
             print(f"[step {step}] {pretty}", flush=True)
         if self._fh:
             self._fh.write(json.dumps(record) + "\n")
+        if self._tb:
+            for k, v in scalars.items():
+                if isinstance(v, (int, float)):
+                    self._tb.add_scalar(k, v, global_step=step)
 
     def log_text(self, msg: str) -> None:
         if is_primary_process():
@@ -42,3 +63,6 @@ class MetricLogger:
         if self._fh:
             self._fh.close()
             self._fh = None
+        if self._tb:
+            self._tb.close()
+            self._tb = None

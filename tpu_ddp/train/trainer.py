@@ -108,6 +108,7 @@ class TrainConfig:
     checkpoint_every_epochs: int = 10     # save on log epochs, main.py:45
     resume: bool = False
     jsonl_path: Optional[str] = None
+    tensorboard_dir: Optional[str] = None  # TB scalar events (SURVEY §5.5)
     profile_dir: Optional[str] = None     # emit an XLA/TPU trace (Tensor-
                                           # Board/Perfetto) for ONE steady-
                                           # state epoch (SURVEY.md §5.1)
@@ -284,7 +285,10 @@ class Trainer:
             self._init_strategy_steps(loss_fn, with_acc)
         self._prefetcher = None   # built lazily on first epoch
         self.history: dict = {"epoch": [], "train_loss": []}
-        self.logger = MetricLogger(jsonl_path=config.jsonl_path)
+        self.logger = MetricLogger(
+            jsonl_path=config.jsonl_path,
+            tensorboard_dir=config.tensorboard_dir,
+        )
 
         self.checkpointer = None
         if config.checkpoint_dir:
