@@ -50,6 +50,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="clip the global gradient norm before the update "
                         "(0 = off); on DP the clip sees the synchronized "
                         "gradient, so replicas clip identically")
+    p.add_argument("--mixup-alpha", type=float, default=0.0,
+                   help="on-device mixup: one Beta(alpha,alpha) lambda per "
+                        "shard step blends images and the CE loss "
+                        "(0 = off, typical 0.2); composes with --augment")
     p.add_argument("--ema-decay", type=float, default=0.0,
                    help="maintain an exponential moving average of the "
                         "params (0 = off, typical 0.999); eval and "
@@ -263,6 +267,7 @@ def config_from_args(args) -> TrainConfig:
         shuffle=not args.no_shuffle,
         reshuffle_each_epoch=not args.faithful_epoch_order,
         augment=args.augment,
+        mixup_alpha=args.mixup_alpha,
         sync_bn=args.sync_bn,
         sp_flash=args.sp_flash,
         compute_dtype=args.compute_dtype,

@@ -43,3 +43,28 @@ def random_crop_flip(
     cropped = jax.vmap(crop_one)(padded, offsets)
     flip = jax.random.bernoulli(key_flip, flip_prob, (b,))
     return jnp.where(flip[:, None, None, None], cropped[:, :, ::-1, :], cropped)
+
+
+def mixup(key: jax.Array, images: jax.Array, *, alpha: float, valid=None):
+    """Mixup (Zhang et al. 2018): one shared lambda ~ Beta(alpha, alpha)
+    per shard batch, each image blended with a permuted partner.
+
+    Returns ``(mixed_images, perm, lam)``; the caller mixes the LOSS as
+    ``lam * loss(y) + (1 - lam) * loss(y[perm])`` — the standard hard-label
+    formulation, so no soft-label loss variant is needed. Fully jittable;
+    runs inside the train step like ``random_crop_flip`` (device-side, key
+    derived from ``state.step`` so resume reproduces the same mixes).
+
+    ``valid`` (bool (B,), the loader's wrap-pad mask): a row whose drawn
+    partner is INVALID mixes with itself instead (identity mix) — pad
+    duplicates must never leak their image or label into a valid row's
+    loss, preserving the loader's masking invariant on short final batches.
+    """
+    b = images.shape[0]
+    key_lam, key_perm = jax.random.split(key)
+    lam = jax.random.beta(key_lam, alpha, alpha)
+    perm = jax.random.permutation(key_perm, b)
+    if valid is not None:
+        perm = jnp.where(valid[perm], perm, jnp.arange(b))
+    mixed = lam * images + (1.0 - lam) * images[perm]
+    return mixed, perm, lam

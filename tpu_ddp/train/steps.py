@@ -59,6 +59,7 @@ def _make_shard_step(
     remat: bool = False,
     augment: bool = False,
     augment_seed: int = 0,
+    mixup_alpha: float = 0.0,
     aux_weight: float = 0.01,
 ):
     """Per-shard train-step body shared by the single-step and scanned
@@ -85,6 +86,12 @@ def _make_shard_step(
     def compute_loss(params, batch_stats, batch):
         logits, mutated = apply_model(params, batch_stats, batch["image"])
         task = loss_fn(logits, batch["label"], batch.get("mask"))
+        if mixup_alpha > 0:
+            # hard-label mixup: blend the two CE terms by the same lambda
+            # the images were blended with (data/augment.py::mixup)
+            task = (batch["_mix_lam"] * task
+                    + (1.0 - batch["_mix_lam"])
+                    * loss_fn(logits, batch["_mix_label"], batch.get("mask")))
         loss, aux = combine_aux_loss(task, mutated, aux_weight)
         # Gradient sync lives HERE: pmean-ing the per-shard loss before
         # differentiation makes reverse-mode AD produce the globally
@@ -99,12 +106,23 @@ def _make_shard_step(
         return loss, (mutated.get("batch_stats", batch_stats), logits, task, aux)
 
     def shard_step(state: TrainState, batch: Batch):
+        if augment or mixup_alpha > 0:
+            key = jax.random.fold_in(jax.random.key(augment_seed), state.step)
+            key = jax.random.fold_in(key, lax.axis_index(data_axis))
         if augment:
             from tpu_ddp.data.augment import random_crop_flip
 
-            key = jax.random.fold_in(jax.random.key(augment_seed), state.step)
-            key = jax.random.fold_in(key, lax.axis_index(data_axis))
             batch = dict(batch, image=random_crop_flip(key, batch["image"]))
+        if mixup_alpha > 0:
+            from tpu_ddp.data.augment import mixup
+
+            # distinct stream from crop/flip (same key would correlate them)
+            mixed, perm, lam = mixup(
+                jax.random.fold_in(key, 1), batch["image"],
+                alpha=mixup_alpha, valid=batch.get("mask"),
+            )
+            batch = dict(batch, image=mixed,
+                         _mix_label=batch["label"][perm], _mix_lam=lam)
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
         (_, (new_stats, logits, task, aux)), grads = grad_fn(
             state.params, state.batch_stats, batch
@@ -145,6 +163,7 @@ def make_train_step(
     remat: bool = False,
     augment: bool = False,
     augment_seed: int = 0,
+    mixup_alpha: float = 0.0,
     aux_weight: float = 0.01,
 ) -> Callable[[TrainState, Batch], tuple]:
     """Build the compiled DDP train step for `mesh`.
@@ -167,6 +186,7 @@ def make_train_step(
         remat=remat,
         augment=augment,
         augment_seed=augment_seed,
+        mixup_alpha=mixup_alpha,
         aux_weight=aux_weight,
     )
     sharded = jax.shard_map(
@@ -191,6 +211,7 @@ def make_scan_train_step(
     remat: bool = False,
     augment: bool = False,
     augment_seed: int = 0,
+    mixup_alpha: float = 0.0,
     aux_weight: float = 0.01,
 ) -> Callable[[TrainState, Batch], tuple]:
     """K train steps fused into ONE dispatch via ``lax.scan``.
@@ -216,6 +237,7 @@ def make_scan_train_step(
         remat=remat,
         augment=augment,
         augment_seed=augment_seed,
+        mixup_alpha=mixup_alpha,
         aux_weight=aux_weight,
     )
 

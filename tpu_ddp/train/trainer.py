@@ -71,6 +71,8 @@ class TrainConfig:
     reshuffle_each_epoch: bool = True     # False = faithful missing-set_epoch
     augment: bool = False                 # on-device random crop+flip
                                           # (reference has none; SURVEY §7.3)
+    mixup_alpha: float = 0.0              # >0: on-device mixup (Beta(a,a)
+                                          # image/loss blending; recipe knob)
     sync_bn: bool = False
     sp_flash: bool = False               # SP: flash-kernel ring blocks
     compute_dtype: str = "float32"        # float32 | bfloat16 (MXU 2x)
@@ -336,9 +338,10 @@ class Trainer:
         if config.grad_accum_steps > 1:
             from tpu_ddp.train.steps import make_grad_accum_train_step
 
-            if config.augment:
+            if config.augment or config.mixup_alpha > 0:
                 raise ValueError(
-                    "--augment is not yet supported with --grad-accum-steps"
+                    "--augment/--mixup-alpha are not yet supported with "
+                    "--grad-accum-steps"
                 )
             self.train_step = make_grad_accum_train_step(
                 self.model, self.tx, self.mesh,
@@ -351,6 +354,7 @@ class Trainer:
                 self.model, self.tx, self.mesh,
                 loss_fn=loss_fn, compute_accuracy=with_acc, remat=config.remat,
                 augment=config.augment, augment_seed=config.seed,
+                mixup_alpha=config.mixup_alpha,
                 aux_weight=config.aux_weight,
             )
         self.multi_step = None
@@ -375,6 +379,7 @@ class Trainer:
                 loss_fn=loss_fn, compute_accuracy=with_acc,
                 remat=config.remat,
                 augment=config.augment, augment_seed=config.seed,
+                mixup_alpha=config.mixup_alpha,
                 aux_weight=config.aux_weight,
             )
             self.stacked_sharding = stacked_batch_sharding(self.mesh)
@@ -392,6 +397,7 @@ class Trainer:
 
         for flag, name in (
             (config.augment, "--augment"),
+            (config.mixup_alpha > 0, "--mixup-alpha"),
             (config.remat, "--remat"),
             (config.sync_bn, "--sync-bn"),
             (config.grad_accum_steps > 1, "--grad-accum-steps"),
