@@ -23,4 +23,4 @@ Cross-cutting: tpu_ddp.checkpoint (orbax), tpu_ddp.metrics (timers, JSONL,
 device memory stats), tpu_ddp.ops (Pallas TPU kernels).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
