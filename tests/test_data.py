@@ -231,6 +231,8 @@ def test_synthetic_hard_split_and_noise_semantics():
     assert 0.1 < flipped < 0.25  # 0.2 * (1 - 1/10) expected ~0.18
 
 
+@pytest.mark.slow  # trains a real conv net to pin task learnability; format/shortcut
+# pins stay fast
 def test_synthetic_hard_is_learnable_by_conv_net():
     """A small conv net must beat chance comfortably (the signal is real and
     shift-invariant) while staying below the easy task's trivial 1.0."""

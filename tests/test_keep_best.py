@@ -1,0 +1,44 @@
+"""--keep-best: retain the best-test-accuracy checkpoint alongside the
+periodic step-keyed ones."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpu_ddp.train.trainer import TrainConfig, Trainer
+
+
+def test_keep_best_requires_eval_and_checkpoint_dir(tmp_path):
+    with pytest.raises(ValueError, match="keep-best"):
+        Trainer(TrainConfig(synthetic_data=True, keep_best=True,
+                            checkpoint_dir=str(tmp_path)))  # no eval
+    with pytest.raises(ValueError, match="keep-best"):
+        Trainer(TrainConfig(synthetic_data=True, keep_best=True,
+                            eval_each_epoch=True))  # no dir
+
+
+@pytest.mark.slow  # full 3-epoch trainer run (~50s); the guard test stays fast
+def test_keep_best_tracks_argmax_accuracy(tmp_path):
+    """After a run, best/metadata.json records the max test accuracy seen
+    and the best checkpoint restores to that step's params."""
+    ck = str(tmp_path / "ck")
+    cfg = TrainConfig(
+        synthetic_data=True, synthetic_size=128, per_shard_batch=4,
+        epochs=3, lr=0.05, seed=0, log_every_epochs=1,
+        eval_each_epoch=True, checkpoint_dir=ck,
+        checkpoint_every_epochs=1, keep_best=True,
+    )
+    t = Trainer(cfg)
+    t.run()
+    accs = t.history["test_accuracy"]
+    meta = json.load(open(os.path.join(ck, "best", "metadata.json")))
+    assert meta["test_accuracy"] == pytest.approx(max(accs))
+
+    from tpu_ddp.checkpoint import Checkpointer
+
+    best = Checkpointer(os.path.join(ck, "best"))
+    assert best.latest_step() == meta["step"]
+    restored = best.restore(t.state)
+    assert int(np.asarray(restored.step)) == meta["step"]

@@ -4,6 +4,7 @@ the jnp reference exactly, forward and backward."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpu_ddp.ops.flash_attention import _reference, flash_attention
 
@@ -163,6 +164,8 @@ def test_flash_attention_lowers_to_mosaic_for_tpu():
     assert text_bwd.count("stablehlo.custom_call @tpu_custom_call") == 3
 
 
+@pytest.mark.slow  # interpret-mode Pallas inside a full train step; kernel math and
+# AOT compile pins stay fast
 def test_flash_kernel_runs_inside_gspmd_train_step(devices, monkeypatch):
     """The Pallas kernel executing INSIDE a real train step (round-2 verdict
     weak #4: the shard_map step's interpret path falls back to jnp under

@@ -199,6 +199,8 @@ def test_scan_multi_step_matches_sequential(devices):
     assert int(state_b.step) == K
 
 
+@pytest.mark.slow  # trainer-level scan fusion e2e; the step-level equivalence pin
+# (test_scan_multi_step_matches_sequential) stays fast
 def test_trainer_steps_per_call(devices, tmp_path):
     """Trainer with steps_per_call>1 trains (loss drops) and logs one loss
     per optimizer step, including the non-multiple epoch remainder."""

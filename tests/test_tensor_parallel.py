@@ -268,6 +268,8 @@ def test_cnn_tp_step_matches_unsharded_math(devices):
     assert np.isfinite(float(m2["loss"]))
 
 
+@pytest.mark.slow  # covers all five ResNet variants' rules; the single-model cnn-tp
+# math pins stay in the fast set
 def test_cnn_tp_resnet_family_rules(devices):
     """The auto-named flax paths of resnet_family (Conv_0, BatchNorm_0,
     stem_conv, head) all match CNN_TP_RULES, and a resnet18 TP step

@@ -183,6 +183,7 @@ def test_grad_accum_matches_full_batch(devices):
         )
 
 
+@pytest.mark.slow  # subprocess CLI e2e; the grad-accum math pin stays fast
 def test_grad_accum_cli_and_guards(tmp_path, devices):
     from tpu_ddp.cli.train import main
 

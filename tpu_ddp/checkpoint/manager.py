@@ -29,6 +29,17 @@ class Checkpointer:
         if wait:
             self.manager.wait_until_finished()
 
+    def save_as_only(self, step: int, state: Any) -> None:
+        """Replace whatever checkpoints exist with this one. The best-
+        checkpoint slot needs this instead of max_to_keep=1: retention
+        keys on step NUMBER, but a post-crash resume can replay a new best
+        at a step older than the recorded one — plain save() would either
+        collide on an existing step or lose the new best to retention."""
+        for s in self.manager.all_steps():
+            if s != step:
+                self.manager.delete(s)
+        self.manager.save(step, args=ocp.args.StandardSave(state), force=True)
+
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
 

@@ -135,6 +135,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every-epochs", type=int, default=10)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--keep-best", action="store_true",
+                   help="also retain the best-test-accuracy checkpoint "
+                        "under <checkpoint-dir>/best (needs "
+                        "--eval-each-epoch; best step + accuracy recorded "
+                        "in best/metadata.json)")
     p.add_argument("--jsonl", default=None, help="metrics JSONL path")
     p.add_argument("--tensorboard-dir", default=None,
                    help="write TensorBoard scalar events here "
@@ -288,6 +293,7 @@ def config_from_args(args) -> TrainConfig:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every_epochs=args.checkpoint_every_epochs,
         resume=args.resume,
+        keep_best=args.keep_best,
         jsonl_path=args.jsonl,
         tensorboard_dir=args.tensorboard_dir,
         profile_dir=args.profile_dir,

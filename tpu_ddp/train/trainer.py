@@ -15,6 +15,7 @@ timing, images/sec/chip, JSONL metrics, resume.
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
 import time
 from typing import Optional
@@ -108,6 +109,9 @@ class TrainConfig:
     eval_each_epoch: bool = False
     checkpoint_dir: Optional[str] = None
     checkpoint_every_epochs: int = 10     # save on log epochs, main.py:45
+    keep_best: bool = False               # also retain the best-test-acc
+                                          # checkpoint under
+                                          # <checkpoint-dir>/best
     resume: bool = False
     jsonl_path: Optional[str] = None
     tensorboard_dir: Optional[str] = None  # TB scalar events (SURVEY §5.5)
@@ -293,10 +297,28 @@ class Trainer:
         )
 
         self.checkpointer = None
+        self.best_checkpointer = None
+        self._best_acc = float("-inf")
+        if config.keep_best and not (
+            config.checkpoint_dir and config.eval_each_epoch
+            and config.loss == "ce"
+        ):
+            raise ValueError(
+                "--keep-best needs --checkpoint-dir and --eval-each-epoch "
+                "(and a CE loss: 'best' is keyed on test accuracy)"
+            )
         if config.checkpoint_dir:
             from tpu_ddp.checkpoint import Checkpointer
 
             self.checkpointer = Checkpointer(config.checkpoint_dir)
+            if config.keep_best:
+                best_dir = os.path.join(config.checkpoint_dir, "best")
+                self.best_checkpointer = Checkpointer(best_dir, max_to_keep=1)
+                meta = os.path.join(best_dir, "metadata.json")
+                if config.resume and os.path.isfile(meta):
+                    # don't demote a resumed run's best on the first eval
+                    with open(meta) as f:
+                        self._best_acc = json.load(f)["test_accuracy"]
             if config.resume and self.checkpointer.latest_step() is not None:
                 from tpu_ddp.parallel.mesh import replicated_sharding
 
@@ -864,6 +886,23 @@ class Trainer:
                     )
                     self.history.setdefault("test_accuracy", []).append(acc)
                     last_metrics["test_accuracy"] = acc
+                    if self.best_checkpointer and acc > self._best_acc:
+                        self._best_acc = acc
+                        step_now = int(self.state.step)
+                        # save_as_only: resume replay can produce a new
+                        # best at an existing or OLDER step number
+                        self.best_checkpointer.save_as_only(
+                            step_now, self.state)
+                        from tpu_ddp.parallel.runtime import (
+                            is_primary_process,
+                        )
+
+                        if is_primary_process():
+                            meta = os.path.join(
+                                c.checkpoint_dir, "best", "metadata.json")
+                            with open(meta, "w") as f:
+                                json.dump({"step": step_now,
+                                           "test_accuracy": acc}, f)
                 else:
                     self.logger.log(int(self.state.step), test_loss=loss)
         throughput.stop(wait_for=self.state.params)
@@ -872,6 +911,8 @@ class Trainer:
         self.logger.log_text(f"training time: {total:.3f} seconds")
         if self.checkpointer:
             self.checkpointer.save(int(self.state.step), self.state, wait=True)
+        if self.best_checkpointer:
+            self.best_checkpointer.manager.wait_until_finished()
         from tpu_ddp.parallel.runtime import is_primary_process
 
         if c.plot_curves and is_primary_process():
