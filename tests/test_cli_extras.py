@@ -188,3 +188,25 @@ def test_optimizer_flag_cli():
         "--eval-each-epoch", "--log-every-epochs", "1",
     ])
     assert metrics["test_accuracy"] > 0.2  # easy task, tiny budget
+
+
+def test_eval_only_cli(tmp_path):
+    """--eval-only restores and reproduces the trained accuracy without
+    training (the load-and-infer workflow, ppe_main_ddp.py:310-396); and
+    refuses to run with no weight source."""
+    ck = str(tmp_path / "ck")
+    common = [
+        "--device", "cpu", "--synthetic-data", "--synthetic-size", "128",
+        "--batch-size", "4", "--log-every-epochs", "1",
+    ]
+    trained = main(common + [
+        "--epochs", "2", "--checkpoint-dir", ck,
+        "--checkpoint-every-epochs", "1",
+    ])
+    evaled = main(common + ["--eval-only", "--resume",
+                            "--checkpoint-dir", ck])
+    assert evaled["eval_only"] is True
+    assert evaled["test_accuracy"] == pytest.approx(trained["test_accuracy"])
+
+    with pytest.raises(SystemExit, match="eval-only needs weights"):
+        main(common + ["--eval-only"])

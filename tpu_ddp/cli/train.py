@@ -135,6 +135,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every-epochs", type=int, default=10)
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--eval-only", action="store_true",
+                   help="skip training: restore (--resume from "
+                        "--checkpoint-dir, or --pretrained-dir) and run "
+                        "the test-set eval / prediction outputs — the "
+                        "load-and-infer workflow of ppe_main_ddp.py:310-396")
     p.add_argument("--keep-best", action="store_true",
                    help="also retain the best-test-accuracy checkpoint "
                         "under <checkpoint-dir>/best (needs "
@@ -370,8 +375,15 @@ def main(argv=None) -> dict:
     initialize_distributed()
     if args.cv_mode:
         return run_cv(args, config)
+    if args.eval_only and not (
+        (config.resume and config.checkpoint_dir) or config.pretrained_dir
+    ):
+        raise SystemExit(
+            "--eval-only needs weights: --checkpoint-dir ... --resume, "
+            "or --pretrained-dir ..."
+        )
     trainer = Trainer(config)
-    metrics = trainer.run()
+    metrics = {"eval_only": True} if args.eval_only else trainer.run()
     if metrics.get("preempted"):
         # Drained on a preemption signal: the checkpoint is written; every
         # second of post-run work (eval compile, prediction dumps) eats
