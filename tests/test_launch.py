@@ -26,6 +26,14 @@ from tpu_ddp.cli.launch import (
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _repo_env(base=None):
+    """Env whose PYTHONPATH lets the launcher and path-invoked workers
+    import tpu_ddp from the checkout (nothing is pip-installed in CI)."""
+    env = dict(os.environ if base is None else base)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
 # ------------------------------------------------------------- fast/pure --
 
 def test_plan_ranks_dense_node_major():
@@ -115,8 +123,7 @@ _READY_PRELUDE = (
 
 
 def _launch_and_signal(body: str, ready_dir, grace: str):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env = _repo_env()
     env["TPU_DDP_TERM_GRACE"] = grace
     env["READY_DIR"] = str(ready_dir)
     p = subprocess.Popen(
@@ -182,9 +189,8 @@ def test_launch_two_node_emulation(tmp_path):
     exact command pattern a 2-host pod uses, emulated on localhost."""
     from tpu_ddp.parallel.runtime import scrubbed_cpu_env
 
-    env = scrubbed_cpu_env()
+    env = _repo_env(scrubbed_cpu_env())
     env.pop("TPU_DDP_COORDINATOR", None)
-    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     port = pick_free_port()
     outs = [tmp_path / "node0.txt", tmp_path / "node1.txt"]
     nodes = []
@@ -214,11 +220,8 @@ def test_launch_two_process_rendezvous_end_to_end(tmp_path):
     from tpu_ddp.parallel.runtime import scrubbed_cpu_env
 
     out = tmp_path / "out.txt"
-    env = scrubbed_cpu_env()
+    env = _repo_env(scrubbed_cpu_env())
     env.pop("TPU_DDP_COORDINATOR", None)
-    # both the launcher and the path-invoked worker must import tpu_ddp
-    # from the repo checkout (neither is pip-installed in CI)
-    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     with open(out, "w") as f:
         p = subprocess.run(
             [sys.executable, "-m", "tpu_ddp.cli.launch",

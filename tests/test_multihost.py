@@ -8,7 +8,6 @@ this is the one that actually executes the ``process_count > 1`` branch.
 """
 
 import os
-import socket
 import subprocess
 import sys
 
@@ -17,10 +16,7 @@ import pytest
 pytestmark = pytest.mark.slow  # multi-process / e2e-CLI / AOT: make test-all
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from tpu_ddp.cli.launch import pick_free_port as _free_port  # noqa: E402
 
 
 def test_two_process_trainer_batch_assembly_and_step():

@@ -57,8 +57,11 @@ def _term_grace() -> float:
     """Seconds a TERM'd job gets to drain before SIGKILL. Overridable via
     TPU_DDP_TERM_GRACE: preemption notices vary (GCE gives 30s, a pod
     maintenance event may give minutes) and the drain needs the window."""
+    raw = os.environ.get(TERM_GRACE_ENV)
+    if raw is None:
+        return _TERM_GRACE_SECONDS
     try:
-        return float(os.environ.get(TERM_GRACE_ENV, ""))
+        return float(raw)
     except ValueError:
         return _TERM_GRACE_SECONDS
 
