@@ -173,3 +173,96 @@ def resnet152(num_classes: int = 10, bn_cross_replica_axis=None, cifar_stem=True
     return ResNet((3, 8, 36, 3), _Bottleneck, num_classes=num_classes,
                   cifar_stem=cifar_stem, bn_cross_replica_axis=bn_cross_replica_axis,
                   dtype=dtype)
+
+
+class _WideBlock(nn.Module):
+    """Pre-activation wide basic block (Zagoruyko & Komodakis 2016):
+    BN-ReLU-Conv ×2 with the identity (or 1x1-projected) shortcut taken
+    AFTER the first activation — the WRN paper's layout, distinct from the
+    post-activation `_BasicBlock` above."""
+
+    filters: int
+    strides: int = 1
+    bn_cross_replica_axis: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            axis_name=self.bn_cross_replica_axis,
+            dtype=self.dtype,
+        )
+        conv = partial(nn.Conv, use_bias=False, kernel_init=_he_init,
+                       dtype=self.dtype)
+
+        y = nn.relu(norm()(x))
+        # the projected shortcut branches from the PRE-activated tensor
+        shortcut = x
+        if x.shape[-1] != self.filters or self.strides != 1:
+            shortcut = conv(self.filters, (1, 1),
+                            strides=(self.strides, self.strides))(y)
+        y = conv(self.filters, (3, 3),
+                 strides=(self.strides, self.strides), padding=1)(y)
+        y = conv(self.filters, (3, 3), padding=1)(nn.relu(norm()(y)))
+        return y + shortcut
+
+
+class WideResNet(nn.Module):
+    """WRN-depth-widen for 32x32 inputs: the canonical 94%+ CIFAR-10
+    family (the margin config of BASELINE.md's 93% pathway). depth must be
+    6n+4; three stages of n pre-activation blocks at widths
+    (16, 32, 64) * widen, final BN-ReLU before global pooling."""
+
+    depth: int = 28
+    widen: int = 10
+    num_classes: int = 10
+    bn_cross_replica_axis: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if (self.depth - 4) % 6:
+            raise ValueError(f"WRN depth must be 6n+4, got {self.depth}")
+        n = (self.depth - 4) // 6
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False,
+                    kernel_init=_he_init, dtype=self.dtype,
+                    name="stem_conv")(x)
+        for stage, width in enumerate((16, 32, 64)):
+            for b in range(n):
+                x = _WideBlock(
+                    filters=width * self.widen,
+                    strides=2 if (b == 0 and stage > 0) else 1,
+                    bn_cross_replica_axis=self.bn_cross_replica_axis,
+                    dtype=self.dtype,
+                )(x, train=train)
+        x = nn.relu(nn.BatchNorm(
+            use_running_average=not train, momentum=0.9,
+            axis_name=self.bn_cross_replica_axis, dtype=self.dtype,
+            name="final_bn",
+        )(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+@register("wrn28_10")
+def wrn28_10(num_classes: int = 10, bn_cross_replica_axis=None,
+             cifar_stem=True, dtype=jnp.float32):
+    """The WRN paper's headline CIFAR config (36.5M params)."""
+    del cifar_stem  # WRN is 32x32-native; kwarg kept for zoo uniformity
+    return WideResNet(depth=28, widen=10, num_classes=num_classes,
+                      bn_cross_replica_axis=bn_cross_replica_axis,
+                      dtype=dtype)
+
+
+@register("wrn16_4")
+def wrn16_4(num_classes: int = 10, bn_cross_replica_axis=None,
+            cifar_stem=True, dtype=jnp.float32):
+    """Small WRN: fast-suite-sized member of the same family."""
+    del cifar_stem
+    return WideResNet(depth=16, widen=4, num_classes=num_classes,
+                      bn_cross_replica_axis=bn_cross_replica_axis,
+                      dtype=dtype)
