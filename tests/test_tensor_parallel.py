@@ -361,3 +361,39 @@ def test_fsdp_adamw_moments_sharded_like_params(devices):
     # second step (donation) still runs and learns
     new_state, metrics2 = step(new_state, _batch(16, seed=4))
     assert np.isfinite(float(metrics2["loss"]))
+
+
+@pytest.mark.slow  # fresh WRN compile; the rule-spec asserts alone are cheap
+def test_cnn_tp_wide_resnet_rules(devices):
+    """WideResNet joins the conv TP family: every param (incl. final_bn)
+    matches a rule, and a WRN-16-4 TP step reproduces the unsharded math."""
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+    from tpu_ddp.parallel.tensor_parallel import CNN_TP_RULES
+
+    mesh = create_mesh(MeshSpec(data=2, model=4), devices)
+    model = MODEL_REGISTRY["wrn16_4"](num_classes=10)
+    tx = make_optimizer(lr=0.01, momentum=0.9)
+    state = create_train_state(model, tx, jax.random.key(1))
+    batch = _batch(16, seed=5)
+
+    specs = specs_for_params(state.params, CNN_TP_RULES)
+    assert specs["stem_conv"]["kernel"] == P(None, None, None, "model")
+    assert specs["_WideBlock_0"]["Conv_0"]["kernel"] == P(
+        None, None, None, "model"
+    )
+    assert specs["_WideBlock_0"]["BatchNorm_0"]["scale"] == P("model")
+    assert specs["final_bn"]["scale"] == P("model")  # the WRN-only path
+    assert specs["head"]["kernel"] == P("model", None)
+
+    logits, _ = model.apply(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        jnp.asarray(batch["image"]), train=True, mutable=["batch_stats"],
+    )
+    ref_loss = float(cross_entropy_loss(
+        logits, jnp.asarray(batch["label"]), jnp.asarray(batch["mask"])
+    ))
+    step, shardings = make_tp_train_step(
+        model, tx, mesh, state, rules=CNN_TP_RULES, has_batch_stats=True
+    )
+    _, metrics = step(shard_train_state(state, shardings), batch)
+    assert abs(float(metrics["loss"]) - ref_loss) < 5e-4

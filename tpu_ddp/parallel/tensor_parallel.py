@@ -68,7 +68,8 @@ CNN_TP_RULES = (
                   P(None, None, None, MODEL_AXIS)),
     PartitionRule(r"(conv[^/]*|Conv_\d+)/bias$", P(MODEL_AXIS)),
     # BN params follow the channel-sharded activations they normalize
-    PartitionRule(r"(batch_norm|BatchNorm_\d+|stem_bn)/(scale|bias)$",
+    # (final_bn: WideResNet's pre-pooling BN)
+    PartitionRule(r"(batch_norm|BatchNorm_\d+|stem_bn|final_bn)/(scale|bias)$",
                   P(MODEL_AXIS)),
     # NetResDeep head pair (fc1 -> relu -> fc2)
     PartitionRule(r"fc1/kernel$", P(None, MODEL_AXIS)),

@@ -216,18 +216,19 @@ def _tp_rules_for(model, parallelism: str):
     while reporting tensor parallelism would be worse than the error."""
     from tpu_ddp.models.moe import MoEViT
     from tpu_ddp.models.resnet import NetResDeep
-    from tpu_ddp.models.resnet_family import ResNet
+    from tpu_ddp.models.resnet_family import ResNet, WideResNet
     from tpu_ddp.models.vit import ViT
     from tpu_ddp.parallel.tensor_parallel import CNN_TP_RULES, VIT_TP_RULES
 
     if isinstance(model, (ViT, MoEViT)):
         return VIT_TP_RULES
-    if isinstance(model, (NetResDeep, ResNet)):
+    if isinstance(model, (NetResDeep, ResNet, WideResNet)):
         return CNN_TP_RULES
     raise ValueError(
         f"--parallelism {parallelism} has no partition-rule set for "
         f"{type(model).__name__}; supported families: ViT/MoEViT "
-        "(Megatron rules) and NetResDeep/ResNet (channel-sharding rules)"
+        "(Megatron rules) and NetResDeep/ResNet/WideResNet "
+        "(channel-sharding rules)"
     )
 
 
