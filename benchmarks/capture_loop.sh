@@ -31,9 +31,12 @@ except Exception:
 # sweep leg burned a full 900s window; see capture_tpu._LEG_CODE). The
 # committed doc already holds the flagship fusion grid under "sweep", so the
 # sweep_k*_b* point legs are deliberately NOT re-requested here.
+# Order = capture priority (a window can close mid-list): the still-
+# missing legs are requested most-informative first — the ImageNet-shape
+# conv row, then the fused headline tuning, then the batch-sweep points.
 legs = ("flagship", "baseline", "compute", "attention", "attention_op",
-        "vit_compute", "compute_b128", "compute_b512",
-        "compute_fused", "compute_imagenet")
+        "vit_compute", "compute_imagenet", "compute_fused",
+        "compute_b512", "compute_b128")
 print(",".join(k for k in legs if k not in doc))
 EOF
 )
