@@ -173,6 +173,19 @@ def main() -> None:
         lambda: step50.trace(state50, batch_for(256 * 8)).lower().compile(),
     )
 
+    # 2b. WideResNet-28-10 bf16 (the 94%+ CIFAR margin config, 36.5M
+    # params): compile + memory evidence for the newest model family.
+    wrn = MODEL_REGISTRY["wrn28_10"](num_classes=10, dtype=jnp.bfloat16)
+    txw = make_optimizer(lr=1e-1, momentum=0.9, weight_decay=5e-4)
+    statew = jax.eval_shape(
+        lambda: create_train_state(wrn, txw, jax.random.key(0))
+    )
+    stepw = make_train_step(wrn, txw, mesh)
+    progs["dp_wrn28_10_bf16_b128x8"] = _compile(
+        "dp_wrn28_10_bf16_b128x8",
+        lambda: stepw.trace(statew, batch_for(128 * 8)).lower().compile(),
+    )
+
     # 3. Pallas flash attention, forward and backward (Mosaic codegen for
     # the real device kind).
     import importlib
