@@ -190,6 +190,46 @@ def main() -> None:
                                   stacked_batch(k, per_shard))
             jobs.append((f"sweep_scan{k}_b{per_shard}", sweep))
 
+    # capture legs compute_b128 / compute_b512: resnet50 bf16 sweep points
+    for per_shard in (128, 512):
+        def point(per_shard=per_shard):
+            model = MODEL_REGISTRY["resnet50"](num_classes=10,
+                                               dtype=jnp.bfloat16)
+            tx = make_optimizer(lr=1e-1, momentum=0.9)
+            step = make_train_step(model, tx, mesh)
+            return step.trace(astate(model, tx), flat_batch(per_shard))
+        jobs.append((f"compute_point_b{per_shard}", point))
+
+    # capture leg compute_fused: scan-fused K=8 resnet50 bf16 b256
+    def fused():
+        model = MODEL_REGISTRY["resnet50"](num_classes=10,
+                                           dtype=jnp.bfloat16)
+        tx = make_optimizer(lr=1e-1, momentum=0.9)
+        step = make_scan_train_step(model, tx, mesh, steps_per_call=8)
+        return step.trace(astate(model, tx), stacked_batch(8, 256))
+
+    jobs.append(("compute_fused_scan8_b256", fused))
+
+    # capture leg compute_imagenet: resnet50 bf16, ImageNet stem, 224x224
+    def imagenet():
+        model = MODEL_REGISTRY["resnet50"](
+            num_classes=1000, cifar_stem=False, dtype=jnp.bfloat16)
+        tx = make_optimizer(lr=1e-1, momentum=0.9)
+        step = make_train_step(model, tx, mesh)
+        state224 = abstract_train_state(jax.eval_shape(
+            lambda: create_train_state(model, tx, jax.random.key(0),
+                                       input_shape=(1, 224, 224, 3))
+        ))
+        batch224 = {
+            "image": jax.ShapeDtypeStruct((64, 224, 224, 3), jnp.float32,
+                                          sharding=bs),
+            "label": jax.ShapeDtypeStruct((64,), jnp.int32, sharding=bs),
+            "mask": jax.ShapeDtypeStruct((64,), bool, sharding=bs),
+        }
+        return step.trace(state224, batch224)
+
+    jobs.append(("compute_imagenet_b64_224", imagenet))
+
     before = set(os.listdir(CACHE_DIR)) if os.path.isdir(CACHE_DIR) else set()
     for name, job in jobs:
         t0 = time.time()
