@@ -276,32 +276,27 @@ def _bench_dispatch_baseline() -> dict:
     }
 
 
-def _resnet50_bf16_point(per_shard: int, *, max_calls: int = 50) -> dict:
-    """ONE measured ResNet-50 bf16 train-step point at the given per-shard
-    batch. The headline compute leg and the batch sweep both call this, so
-    the sweep is structurally the SAME measurement as the headline — same
-    optimizer knobs, same seed, same measurement discipline — varying only
-    the batch."""
+def _cifar_compute_point(model, tx, *, per_shard: int, seed: int = 1,
+                         max_calls: int = 50) -> dict:
+    """ONE unfused CIFAR-shape (32x32) measurement point: the single
+    implementation of the flat-batch build and rate math shared by the
+    ResNet-50 headline/sweep legs and the WRN compute leg."""
     import jax
     import numpy as np
 
     from tpu_ddp.data import synthetic_cifar10
     from tpu_ddp.metrics.mfu import compiled_flops, mfu
-    from tpu_ddp.models.zoo import MODEL_REGISTRY
     from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
-    from tpu_ddp.train import create_train_state, make_optimizer, make_train_step
+    from tpu_ddp.train import create_train_state, make_train_step
 
     devices = jax.devices()
     n_chips = len(devices)
     mesh = create_mesh(MeshSpec(data=-1), devices)
-
-    model = MODEL_REGISTRY["resnet50"](num_classes=10, dtype=jax.numpy.bfloat16)
-    tx = make_optimizer(lr=1e-1, momentum=0.9)
     state = create_train_state(model, tx, jax.random.key(0))
     step = make_train_step(model, tx, mesh)
 
     global_batch = per_shard * n_chips
-    imgs, labels = synthetic_cifar10(global_batch, seed=1)
+    imgs, labels = synthetic_cifar10(global_batch, seed=seed)
     batch = {
         "image": imgs.astype(np.float32),
         "label": labels,
@@ -318,6 +313,23 @@ def _resnet50_bf16_point(per_shard: int, *, max_calls: int = 50) -> dict:
         "per_shard_batch": per_shard,
         "n_chips": n_chips,
     }
+
+
+def _resnet50_bf16_point(per_shard: int, *, max_calls: int = 50) -> dict:
+    """ONE measured ResNet-50 bf16 train-step point at the given per-shard
+    batch. The headline compute leg and the batch sweep both call this, so
+    the sweep is structurally the SAME measurement as the headline — same
+    optimizer knobs, same seed, same measurement discipline — varying only
+    the batch."""
+    import jax.numpy as jnp
+
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+    from tpu_ddp.train import make_optimizer
+
+    model = MODEL_REGISTRY["resnet50"](num_classes=10, dtype=jnp.bfloat16)
+    tx = make_optimizer(lr=1e-1, momentum=0.9)
+    return _cifar_compute_point(model, tx, per_shard=per_shard, seed=1,
+                                max_calls=max_calls)
 
 
 def _bench_compute_bound(quick: bool) -> dict:
@@ -416,6 +428,23 @@ def _image224_point(model, tx, *, num_classes: int, per_shard: int,
         "per_shard_batch": per_shard,
         "n_chips": n_chips,
     }
+
+
+def _bench_wrn_compute() -> dict:
+    """WideResNet-28-10 bf16 at CIFAR shape (per-shard 128): the
+    throughput of the model family the 93% accuracy pathway actually
+    recommends (BASELINE.md; 36.5M params of 3x3 convs at width 640 —
+    far better MXU tiling than ResNet-50's 1x1-heavy CIFAR stack)."""
+    import jax.numpy as jnp
+
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+    from tpu_ddp.train import make_optimizer
+
+    model = MODEL_REGISTRY["wrn28_10"](num_classes=10, dtype=jnp.bfloat16)
+    tx = make_optimizer(lr=1e-1, momentum=0.9, weight_decay=5e-4)
+    point = _cifar_compute_point(model, tx, per_shard=128, seed=7,
+                                 max_calls=30)
+    return {"model": "wrn28_10", "dtype": "bfloat16", **point}
 
 
 def _bench_resnet50_imagenet() -> dict:

@@ -35,7 +35,7 @@ except Exception:
 # missing legs are requested most-informative first — the ImageNet-shape
 # conv row, then the fused headline tuning, then the batch-sweep points.
 legs = ("flagship", "baseline", "compute", "attention", "attention_op",
-        "vit_compute", "compute_imagenet", "compute_fused",
+        "vit_compute", "compute_imagenet", "compute_fused", "compute_wrn",
         "compute_b512", "compute_b128")
 print(",".join(k for k in legs if k not in doc))
 EOF

@@ -63,6 +63,8 @@ _LEG_CODE = {
                      "bench._bench_compute_fused()))",
     "compute_imagenet": "import bench; print(__import__('json').dumps("
                         "bench._bench_resnet50_imagenet()))",
+    "compute_wrn": "import bench; print(__import__('json').dumps("
+                   "bench._bench_wrn_compute()))",
     # Flagship fusion-grid points: how far does scan-fusion amortize the
     # per-dispatch cost on the real chip? One (K, per_shard) point — one
     # compile — per leg child. (The committed doc's "sweep" key holds the

@@ -230,6 +230,16 @@ def main() -> None:
 
     jobs.append(("compute_imagenet_b64_224", imagenet))
 
+    # capture leg compute_wrn: WRN-28-10 bf16 b128 (CIFAR shape)
+    def wrn():
+        model = MODEL_REGISTRY["wrn28_10"](num_classes=10,
+                                           dtype=jnp.bfloat16)
+        tx = make_optimizer(lr=1e-1, momentum=0.9, weight_decay=5e-4)
+        step = make_train_step(model, tx, mesh)
+        return step.trace(astate(model, tx), flat_batch(128))
+
+    jobs.append(("compute_wrn28_10_b128", wrn))
+
     before = set(os.listdir(CACHE_DIR)) if os.path.isdir(CACHE_DIR) else set()
     for name, job in jobs:
         t0 = time.time()
