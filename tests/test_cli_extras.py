@@ -210,3 +210,9 @@ def test_eval_only_cli(tmp_path):
 
     with pytest.raises(SystemExit, match="eval-only needs weights"):
         main(common + ["--eval-only"])
+
+    # an EMPTY/mistyped checkpoint dir must fail loudly, not silently
+    # evaluate random init
+    with pytest.raises(SystemExit, match="no checkpoint found"):
+        main(common + ["--eval-only", "--resume",
+                       "--checkpoint-dir", str(tmp_path / "nothing-here")])

@@ -37,7 +37,7 @@ def _md5(path):
     return hashlib.md5(open(path, "rb").read()).hexdigest()
 
 
-def test_download_fetches_verifies_and_loader_extracts(tmp_path):
+def test_download_fetches_verifies_and_extracts(tmp_path):
     src = tmp_path / "served" / "cifar-10-python.tar.gz"
     src.parent.mkdir()
     _fake_cifar10_tar(src)
@@ -47,7 +47,10 @@ def test_download_fetches_verifies_and_loader_extracts(tmp_path):
         url=src.as_uri(), md5=_md5(src),
     )
     assert (data_dir / "cifar-10-python.tar.gz").is_file()
-    imgs, labels = load_cifar10(str(data_dir), train=True)  # auto-extract
+    # extraction happens eagerly in ensure_dataset (single-writer), so the
+    # loader never lazily extracts in a launched multi-process job
+    assert (data_dir / "cifar-10-batches-py" / "data_batch_1").is_file()
+    imgs, labels = load_cifar10(str(data_dir), train=True)
     assert imgs.shape == (20, 32, 32, 3) and labels.shape == (20,)
 
 
@@ -105,16 +108,21 @@ def test_noop_when_extracted_in_loader_candidate_layout(tmp_path):
 
 
 def test_nonzero_local_rank_waits_for_rank_zero(tmp_path, monkeypatch):
-    """In a launched multi-process job only local rank 0 fetches; a
-    non-zero rank polls — and times out loudly if the artifact never
-    appears instead of racing a second download."""
+    """In a launched multi-process job only local rank 0 fetches AND
+    extracts; a non-zero rank polls for the EXTRACTED batches (a bare
+    tarball is not enough — rank 0 may be about to delete an unverified
+    one, and concurrent lazy extraction corrupts reads) — and times out
+    loudly if they never appear instead of racing a second download."""
     monkeypatch.setenv("TPU_DDP_LOCAL_RANK", "1")
+    # a tarball alone does NOT satisfy the wait
+    _fake_cifar10_tar(tmp_path / "cifar-10-python.tar.gz")
     with pytest.raises(TimeoutError, match="local rank 1"):
         ensure_dataset(str(tmp_path), "cifar10", download=True,
                        url="file:///nonexistent", md5="0" * 32,
                        wait_timeout=0.2)
-    # but an artifact already landed by rank 0 satisfies the wait
-    _fake_cifar10_tar(tmp_path / "cifar-10-python.tar.gz")
+    # rank 0's finished extraction does
+    with tarfile.open(tmp_path / "cifar-10-python.tar.gz") as tf:
+        tf.extractall(tmp_path, filter="data")
     ensure_dataset(str(tmp_path), "cifar10", download=True,
                    url="file:///nonexistent", md5="0" * 32,
                    wait_timeout=5.0)

@@ -19,6 +19,25 @@ def test_keep_best_requires_eval_and_checkpoint_dir(tmp_path):
                             eval_each_epoch=True))  # no dir
 
 
+def test_save_as_only_saves_before_deleting(tmp_path):
+    """Successive bests leave exactly one (restorable) checkpoint, and the
+    new save is DURABLE before the old one is deleted — delete-first would
+    open a zero-checkpoint crash window and race async saves."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_ddp.checkpoint import Checkpointer
+
+    state = {"w": jnp.arange(4.0), "step": jnp.asarray(0)}
+    ck = Checkpointer(str(tmp_path / "best"))
+    for step in (5, 12, 9):  # incl. a post-resume OLDER best step
+        ck.save_as_only(step, {**state, "step": jnp.asarray(step)})
+        assert ck.manager.all_steps() == [step]
+    restored = ck.restore(state)
+    assert int(restored["step"]) == 9
+    ck.close()
+
+
 @pytest.mark.slow  # full 3-epoch trainer run (~50s); the guard test stays fast
 def test_keep_best_tracks_argmax_accuracy(tmp_path):
     """After a run, best/metadata.json records the max test accuracy seen

@@ -34,11 +34,17 @@ class Checkpointer:
         checkpoint slot needs this instead of max_to_keep=1: retention
         keys on step NUMBER, but a post-crash resume can replay a new best
         at a step older than the recorded one — plain save() would either
-        collide on an existing step or lose the new best to retention."""
+        collide on an existing step or lose the new best to retention.
+
+        Ordering matters: the NEW checkpoint is saved and awaited (orbax
+        saves are async) BEFORE the old one is deleted — delete-first
+        would leave a crash window with zero best checkpoints, and could
+        race the deletion against a still-in-flight earlier save."""
+        self.manager.save(step, args=ocp.args.StandardSave(state), force=True)
+        self.manager.wait_until_finished()
         for s in self.manager.all_steps():
             if s != step:
                 self.manager.delete(s)
-        self.manager.save(step, args=ocp.args.StandardSave(state), force=True)
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()

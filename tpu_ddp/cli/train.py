@@ -383,6 +383,13 @@ def main(argv=None) -> dict:
             "or --pretrained-dir ..."
         )
     trainer = Trainer(config)
+    if args.eval_only and config.resume and trainer.resumed_step is None:
+        # the one mode whose entire purpose is loading weights must not
+        # silently evaluate random init when the checkpoint dir is empty
+        raise SystemExit(
+            f"--eval-only: no checkpoint found under "
+            f"{config.checkpoint_dir!r} to resume from"
+        )
     metrics = {"eval_only": True} if args.eval_only else trainer.run()
     if metrics.get("preempted"):
         # Drained on a preemption signal: the checkpoint is written; every

@@ -61,6 +61,20 @@ def existing_tarball(data_dir: str, dataset: str):
     return None
 
 
+def ensure_extracted(data_dir: str, dataset: str) -> bool:
+    """Extract the dataset's tarball now if the batches aren't already on
+    disk; True iff the extracted dir exists afterwards. Used by
+    ``download.ensure_dataset`` so ONE process (local rank 0) does the
+    extraction up front — concurrent lazy extraction by several loader
+    processes into the same dir corrupts each other's reads."""
+    if extracted_dataset_dir(data_dir, dataset) is not None:
+        return True
+    if existing_tarball(data_dir, dataset) is None:
+        return False
+    _find_dataset_dir(data_dir, *DATASET_LAYOUTS[dataset])  # extracts
+    return extracted_dataset_dir(data_dir, dataset) is not None
+
+
 def _find_dataset_dir(
     data_dir: str, subdir: str, marker_files, tarball: str, what: str
 ) -> str:

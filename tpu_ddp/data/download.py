@@ -33,6 +33,7 @@ import urllib.request
 
 from tpu_ddp.data.cifar10 import (
     DATASET_LAYOUTS,
+    ensure_extracted,
     existing_tarball,
     extracted_dataset_dir,
 )
@@ -115,26 +116,29 @@ def ensure_dataset(
 
     local_rank = int(os.environ.get("TPU_DDP_LOCAL_RANK", "0") or "0")
     if download and local_rank != 0:
-        # one fetch per host: rank 0 owns the artifact (verify, delete,
-        # re-download); the other ranks only ever WAIT for it — a rank
-        # that deleted a tarball mid-verify would race rank 0's replace
+        # one fetch AND one extraction per host: rank 0 owns the artifact
+        # end-to-end (verify, delete, re-download, extract); the other
+        # ranks wait for the EXTRACTED batches — waiting on the tarball
+        # would accept an unverified archive rank 0 may be about to
+        # delete, and concurrent lazy extraction corrupts reads
         deadline = time.monotonic() + wait_timeout
         while time.monotonic() < deadline:
-            if (extracted_dataset_dir(data_dir, dataset) is not None
-                    or existing_tarball(data_dir, dataset) is not None):
+            if extracted_dataset_dir(data_dir, dataset) is not None:
                 return data_dir
             time.sleep(1.0)
         raise TimeoutError(
             f"local rank {local_rank}: waited {wait_timeout:.0f}s for rank "
-            f"0's {tarball} download under {data_dir!r}"
+            f"0's extracted {dataset} batches under {data_dir!r}"
         )
 
     have = existing_tarball(data_dir, dataset)
     if have is not None:
-        if not download or _md5(have) == md5:
-            # download=False trusts what the user placed (the loader's
-            # pre-existing behavior); download=True verifies like
-            # torchvision and re-fetches a bad archive
+        if not download:
+            return data_dir  # loader trusts what the user placed
+        if _md5(have) == md5:
+            # verified like torchvision; extract NOW (single-writer) so
+            # waiting ranks and every later loader see the batches
+            ensure_extracted(data_dir, dataset)
             return data_dir
         log.warning("%s fails its checksum; re-downloading", have)
         os.remove(have)
@@ -143,4 +147,5 @@ def ensure_dataset(
 
     os.makedirs(data_dir, exist_ok=True)
     _fetch(url, os.path.join(data_dir, tarball), md5)
+    ensure_extracted(data_dir, dataset)
     return data_dir

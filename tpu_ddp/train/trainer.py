@@ -298,6 +298,7 @@ class Trainer:
 
         self.checkpointer = None
         self.best_checkpointer = None
+        self.resumed_step = None      # set iff --resume restored a checkpoint
         self._best_acc = float("-inf")
         if config.keep_best and not (
             config.checkpoint_dir and config.eval_each_epoch
@@ -332,8 +333,9 @@ class Trainer:
                     restored,
                     self.state_shardings or replicated_sharding(self.mesh),
                 )
+                self.resumed_step = int(self.state.step)
                 self.logger.log_text(
-                    f"resumed from step {int(self.state.step)}"
+                    f"resumed from step {self.resumed_step}"
                 )
 
     def _init_dp_steps(self, loss_fn, with_acc):
