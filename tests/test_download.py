@@ -128,6 +128,33 @@ def test_nonzero_local_rank_waits_for_rank_zero(tmp_path, monkeypatch):
                    wait_timeout=5.0)
 
 
+def test_cifar100_download_extract_load_roundtrip(tmp_path):
+    """The layout registry covers CIFAR-100 too: fetch -> verify ->
+    extract -> load through the same path as CIFAR-10."""
+    import tarfile as _tar
+
+    from tpu_ddp.data.cifar10 import load_cifar100
+
+    rng = np.random.default_rng(1)
+    src = tmp_path / "served" / "cifar-100-python.tar.gz"
+    src.parent.mkdir()
+    with _tar.open(src, "w:gz") as tf:
+        for name, n in (("train", 8), ("test", 4)):
+            blob = pickle.dumps({
+                b"data": rng.integers(0, 256, (n, 3072), dtype=np.uint8),
+                b"fine_labels": rng.integers(0, 100, n).tolist(),
+            })
+            info = _tar.TarInfo(f"cifar-100-python/{name}")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    data_dir = tmp_path / "data"
+    ensure_dataset(str(data_dir), "cifar100", download=True,
+                   url=src.as_uri(), md5=_md5(src))
+    imgs, labels = load_cifar100(str(data_dir), train=True)
+    assert imgs.shape == (8, 32, 32, 3)
+    assert labels.max() < 100
+
+
 def test_no_download_leaves_loader_error_intact(tmp_path):
     ensure_dataset(str(tmp_path), "cifar10", download=False)
     with pytest.raises(FileNotFoundError, match="download=False"):
