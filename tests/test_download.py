@@ -164,3 +164,22 @@ def test_no_download_leaves_loader_error_intact(tmp_path):
 def test_unknown_dataset_rejected(tmp_path):
     with pytest.raises(ValueError, match="unknown dataset"):
         ensure_dataset(str(tmp_path), "imagenet", download=True)
+
+
+def test_no_download_rank0_still_extracts_user_placed_tarball(tmp_path):
+    """download=False with a user-placed tarball: extraction still happens
+    once, in ensure_dataset (rank 0), not lazily in every loader process
+    of a launched job."""
+    _fake_cifar10_tar(tmp_path / "cifar-10-python.tar.gz")
+    ensure_dataset(str(tmp_path), "cifar10", download=False)
+    assert (tmp_path / "cifar-10-batches-py" / "data_batch_1").is_file()
+
+
+def test_no_download_nonzero_rank_waits_on_tarball(tmp_path, monkeypatch):
+    """Even with download=False, a non-zero rank seeing a tarball but no
+    batches waits for rank 0's extraction instead of extracting itself."""
+    monkeypatch.setenv("TPU_DDP_LOCAL_RANK", "1")
+    _fake_cifar10_tar(tmp_path / "cifar-10-python.tar.gz")
+    with pytest.raises(TimeoutError, match="local rank 1"):
+        ensure_dataset(str(tmp_path), "cifar10", download=False,
+                       wait_timeout=0.2)

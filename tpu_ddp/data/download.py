@@ -115,12 +115,14 @@ def ensure_dataset(
         return data_dir
 
     local_rank = int(os.environ.get("TPU_DDP_LOCAL_RANK", "0") or "0")
-    if download and local_rank != 0:
+    have = existing_tarball(data_dir, dataset)
+    if local_rank != 0 and (download or have is not None):
         # one fetch AND one extraction per host: rank 0 owns the artifact
-        # end-to-end (verify, delete, re-download, extract); the other
-        # ranks wait for the EXTRACTED batches — waiting on the tarball
-        # would accept an unverified archive rank 0 may be about to
-        # delete, and concurrent lazy extraction corrupts reads
+        # end-to-end (verify, delete, re-download, extract — and with
+        # download=False it still extracts a user-placed tarball); the
+        # other ranks wait for the EXTRACTED batches. Waiting on the
+        # tarball would accept an unverified archive rank 0 may be about
+        # to delete, and concurrent lazy extraction corrupts reads.
         deadline = time.monotonic() + wait_timeout
         while time.monotonic() < deadline:
             if extracted_dataset_dir(data_dir, dataset) is not None:
@@ -131,10 +133,12 @@ def ensure_dataset(
             f"0's extracted {dataset} batches under {data_dir!r}"
         )
 
-    have = existing_tarball(data_dir, dataset)
     if have is not None:
         if not download:
-            return data_dir  # loader trusts what the user placed
+            # loader trusts what the user placed; extract it HERE (rank 0,
+            # single-writer) rather than lazily in every loader process
+            ensure_extracted(data_dir, dataset)
+            return data_dir
         if _md5(have) == md5:
             # verified like torchvision; extract NOW (single-writer) so
             # waiting ranks and every later loader see the batches
