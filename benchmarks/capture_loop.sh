@@ -34,26 +34,29 @@ except Exception:
 # Order = capture priority (a window can close mid-list): the still-
 # missing legs are requested most-informative first — the ImageNet-shape
 # conv row, then the fused headline tuning, then the batch-sweep points.
-legs = ("flagship", "baseline", "compute", "attention", "attention_op",
-        "vit_compute", "compute_imagenet", "compute_fused", "compute_wrn",
-        "compute_b512", "compute_b128")
+legs = ("compute_imagenet", "compute_wrn", "flagship", "baseline",
+        "compute", "attention", "attention_op", "vit_compute",
+        "compute_fused", "compute_b512", "compute_b128",
+        # round-5 legs (registered in capture_tpu._LEG_CODE as they land;
+        # unknown names are skipped harmlessly by capture_tpu)
+        "attention_causal", "moe_vs_dense", "flash_longseq")
 print(",".join(k for k in legs if k not in doc))
 EOF
 )
   REMAIN=$(( DEADLINE - $(date +%s) ))
   if [ -n "$MISSING" ]; then
     python benchmarks/capture_tpu.py --legs "$MISSING" --leg-timeout 900 \
-      >> benchmarks/capture_r4.log 2>&1
+      >> benchmarks/capture_r5.log 2>&1
   elif [ ! -f benchmarks/tpu_curve/summary.json ] \
       && [ "$REMAIN" -ge "$CURVE_BUDGET" ]; then
     python benchmarks/tpu_curve.py --epochs 24 --arm-timeout 1500 \
-      >> benchmarks/capture_r4.log 2>&1
+      >> benchmarks/capture_r5.log 2>&1
   elif [ ! -f benchmarks/recipe_demo_tpu/summary.json ] \
       && [ "$REMAIN" -ge "$RECIPE_BUDGET" ]; then
     # independent of the curve: a window too short for the curve can
     # still fit the recipe run
     python benchmarks/tpu_recipe.py --timeout 2400 \
-      >> benchmarks/capture_r4.log 2>&1
+      >> benchmarks/capture_r5.log 2>&1
   elif [ -f benchmarks/tpu_curve/summary.json ] \
       && [ -f benchmarks/recipe_demo_tpu/summary.json ]; then
     echo "bench legs + accuracy curve + on-chip recipe captured; loop done"

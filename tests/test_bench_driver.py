@@ -68,6 +68,50 @@ def test_bench_always_prints_one_json_line(tmp_path):
     assert "value" in rec and "unit" in rec and "vs_baseline" in rec
 
 
+def test_emit_final_stays_compact(tmp_path, capsys, monkeypatch):
+    """Round-4 regression: the fallback record embedded the full committed
+    bench_tpu.json + AOT program list and the driver recorded parsed:null.
+    _emit_final must keep the printed line under _MAX_FINAL_LINE while
+    preserving the headline contract fields and a summarized TPU headline,
+    and must write the full record to benchmarks/bench_final_full.json."""
+    monkeypatch.setattr(bench, "_FULL_FINAL", str(tmp_path / "full.json"))
+    record = {
+        "metric": "cifar10_train_images_per_sec_per_chip",
+        "value": 10.6, "unit": "images/sec/chip", "vs_baseline": 0.001,
+        "backend": "cpu", "mfu": None,
+        "backend_error": "x" * 2000,
+        "last_recorded_tpu": {
+            "device_kind": "TPU v5 lite",
+            "headline": {"metric": "resnet50_bf16_train_images_per_sec_per_chip",
+                         "value": 1234.5, "unit": "images/sec/chip",
+                         "mfu": 0.338, "vs_baseline": 4.14,
+                         "vs_baseline_source": "measured_capture"},
+            "sweep": {f"k{k}_b{b}": {"images_per_sec_per_chip": 1.0,
+                                     "padding": list(range(200))}
+                      for k in (32, 128) for b in (32, 256)},
+        },
+        "aot_compile_evidence": {"path": "benchmarks/aot_v5e.json",
+                                 "all_ok": True,
+                                 "programs": [f"prog_{i}" for i in range(40)]},
+        "huge_extra": {"blob": "y" * 5000},
+    }
+    bench._emit_final(record)
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert len(line) <= bench._MAX_FINAL_LINE
+    rec = json.loads(line)
+    assert rec["metric"] == "cifar10_train_images_per_sec_per_chip"
+    assert rec["value"] == 10.6 and "vs_baseline" in rec
+    tpu = rec["last_recorded_tpu"]
+    assert tpu["value"] == 1234.5 and tpu["mfu"] == 0.338
+    assert tpu["vs_baseline"] == 4.14
+    assert rec["aot_compile_evidence"]["n_programs"] == 40
+    assert "huge_extra" not in rec
+    full = json.load(open(tmp_path / "full.json"))
+    assert full["huge_extra"]["blob"].startswith("y")
+    assert rec["full_record"].endswith("full.json") or \
+        rec["full_record"].endswith(".json")
+
+
 def test_committed_tpu_evidence_is_valid_json():
     path = os.path.join(_REPO, "benchmarks", "bench_tpu.json")
     with open(path) as f:
