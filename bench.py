@@ -108,7 +108,10 @@ def _emit(result: dict) -> None:
     print(json.dumps(result), flush=True)
 
 
-_FULL_FINAL = os.path.join(_REPO, "benchmarks", "bench_final_full.json")
+_FULL_FINAL = os.environ.get(
+    "BENCH_FULL_FINAL_PATH",
+    os.path.join(_REPO, "benchmarks", "bench_final_full.json"),
+)
 # The driver parses the LAST stdout line; its parse window is unknown but
 # finite (round 4's ~14 KB fallback line — full bench_tpu.json + 17 AOT
 # program names embedded — came back "parsed": null while round 3's smaller
@@ -602,51 +605,125 @@ def _bench_attention() -> dict:
     return out
 
 
+def _time_attn_impl(fn, q, k, v) -> float:
+    """fwd+bwd (grad wrt q,k,v) calls/sec for one attention impl — the ONE
+    implementation of the attention-op timing discipline, shared by every
+    attention microbench leg. Same fencing discipline as _measure: compile,
+    fence, size the timed window from one FENCED call (async dispatch
+    returns in microseconds — an unfenced wall-clock budget never binds and
+    would enqueue hundreds of in-flight multi-MB output sets)."""
+    import jax
+    import jax.numpy as jnp
+
+    loss = jax.jit(jax.value_and_grad(
+        lambda a, b, c: fn(a, b, c).astype(jnp.float32).mean(),
+        (0, 1, 2),
+    ))
+    val, _ = loss(q, k, v)
+    val.block_until_ready()
+    t0 = time.perf_counter()
+    val, _ = loss(q, k, v)
+    val.block_until_ready()
+    per_call = max(time.perf_counter() - t0, 1e-6)
+    calls = int(max(3, min(100, 3.0 / per_call)))
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        val, _ = loss(q, k, v)
+    val.block_until_ready()
+    return calls / (time.perf_counter() - t0)
+
+
+def _attn_qkv(B: int, T: int, H: int, D: int, seed: int):
+    import jax
+    import jax.numpy as jnp
+
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return tuple(jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+                 for kk in ks)
+
+
 def _attention_op_microbench() -> dict:
     """Raw attention-op timing at T=2048 (bf16, B=4, H=8, D=128): the
     long-sequence regime where the flash kernel's VMEM tiling matters,
     timed fwd+bwd (grad wrt q,k,v) for both the Pallas kernel and the
     fused-jnp reference on the same device."""
-    import jax
-    import jax.numpy as jnp
-
     from tpu_ddp.ops.flash_attention import _reference, flash_attention
 
     B, T, H, D = 4, 2048, 8, 128
-    ks = jax.random.split(jax.random.key(3), 3)
-    q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
-               for kk in ks)
-
-    def time_impl(fn):
-        loss = jax.jit(jax.value_and_grad(
-            lambda a, b, c: fn(a, b, c).astype(jnp.float32).mean(),
-            (0, 1, 2),
-        ))
-        # same fencing discipline as _measure: compile, fence, size the
-        # timed window from one FENCED call (async dispatch returns in
-        # microseconds — an unfenced wall-clock budget never binds and
-        # would enqueue hundreds of in-flight 48MB output sets)
-        val, _ = loss(q, k, v)
-        val.block_until_ready()
-        t0 = time.perf_counter()
-        val, _ = loss(q, k, v)
-        val.block_until_ready()
-        per_call = max(time.perf_counter() - t0, 1e-6)
-        calls = int(max(3, min(100, 3.0 / per_call)))
-        t0 = time.perf_counter()
-        for _ in range(calls):
-            val, _ = loss(q, k, v)
-        val.block_until_ready()
-        return calls / (time.perf_counter() - t0)
-
-    full_ips = time_impl(_reference)
-    flash_ips = time_impl(flash_attention)
+    q, k, v = _attn_qkv(B, T, H, D, seed=3)
+    full_ips = _time_attn_impl(_reference, q, k, v)
+    flash_ips = _time_attn_impl(flash_attention, q, k, v)
     return {
         "shape": [B, T, H, D], "dtype": "bfloat16",
         "full_calls_per_sec": round(full_ips, 2),
         "flash_calls_per_sec": round(flash_ips, 2),
         "flash_speedup": round(flash_ips / full_ips, 3),
     }
+
+
+def _vit_step_point(model_name: str) -> dict:
+    """ONE vit_s4-family train-step rate (bf16, per-shard 128, CIFAR shape):
+    the single-compile unit behind the dense-vs-MoE comparison (round-4
+    verdict item 10). One model — ONE fresh XLA compile — per capture
+    child; capture_tpu derives the ratio row once both halves land.
+    Measurement discipline (batch build, fencing, rate math, MFU) is
+    _cifar_compute_point's — the same rows as every other compute leg."""
+    import jax.numpy as jnp
+
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+    from tpu_ddp.train import make_optimizer
+
+    model = MODEL_REGISTRY[model_name](num_classes=10, dtype=jnp.bfloat16)
+    tx = make_optimizer(lr=1e-2, momentum=0.9)
+    return {
+        "model": model_name, "dtype": "bfloat16",
+        **_cifar_compute_point(model, tx, per_shard=128, seed=11,
+                               max_calls=30),
+    }
+
+
+def _bench_dense_step() -> dict:
+    """Dense half of EP's on-chip measurement: the vit_s4 train step whose
+    routed twin is `moe_step`. See _vit_step_point."""
+    return _vit_step_point("vit_s4")
+
+
+def _bench_moe_step() -> dict:
+    """MoE half of EP's on-chip measurement: what the GShard dense-dispatch
+    formulation (router + one-hot dispatch/combine einsums + stacked expert
+    matmuls, E=8) costs end-to-end on one chip. A single chip cannot shard
+    the expert axis, but the routing-formulation cost is the locally-
+    measurable half of the EP story (the all-to-all half is covered by the
+    EP dryrun + AOT legs)."""
+    return _vit_step_point("vit_moe_s4")
+
+
+def _longseq_point(impl_name: str) -> dict:
+    """ONE T=8192 attention fwd+bwd timing point — SP's on-chip measurement
+    (round-4 verdict item 10). T=8192 is the per-device ring tile of the
+    131K-token / 16-device pod leg (131072 / 16); one chip can't run the
+    ring, but the ring's compute is this exact tile, so its rate here is
+    the per-hop cost the AOT'd pod program schedules. B=1 bounds the
+    reference's T^2 score materialization (~1 GiB fwd). One impl — ONE
+    fresh XLA compile — per capture child."""
+    from tpu_ddp.ops.flash_attention import _reference, flash_attention
+
+    B, T, H, D = 1, 8192, 8, 128
+    q, k, v = _attn_qkv(B, T, H, D, seed=5)
+    fn = {"full": _reference, "flash": flash_attention}[impl_name]
+    return {
+        "shape": [B, T, H, D], "dtype": "bfloat16", "impl": impl_name,
+        "ring_context": "per-device tile of the 131072-token/16-device ring",
+        "calls_per_sec": round(_time_attn_impl(fn, q, k, v), 2),
+    }
+
+
+def _bench_longseq_full() -> dict:
+    return _longseq_point("full")
+
+
+def _bench_longseq_flash() -> dict:
+    return _longseq_point("flash")
 
 
 def _is_tpu_child() -> bool:

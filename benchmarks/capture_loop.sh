@@ -34,12 +34,18 @@ except Exception:
 # Order = capture priority (a window can close mid-list): the still-
 # missing legs are requested most-informative first — the ImageNet-shape
 # conv row, then the fused headline tuning, then the batch-sweep points.
-legs = ("compute_imagenet", "compute_wrn", "flagship", "baseline",
-        "compute", "attention", "attention_op", "vit_compute",
-        "compute_fused", "compute_b512", "compute_b128",
-        # round-5 legs (registered in capture_tpu._LEG_CODE as they land;
-        # unknown names are skipped harmlessly by capture_tpu)
-        "attention_causal", "moe_vs_dense", "flash_longseq")
+# Order = capture priority, a window can close mid-list:
+# 1. the two conv headline candidates -- round-5 verdict item 1;
+# 2. the round-5 EP/SP rows -- verdict item 10, one compile per child,
+#    capture_tpu._derive folds the pairs into ratio rows;
+# 3. the already-captured core legs -- only re-requested on a fresh doc;
+# 4. round-4 sweep stragglers, lowest marginal value.
+# No parens in these comments: the registry guard's regex stops at the
+# first close-paren.
+legs = ("compute_imagenet", "compute_wrn",
+        "dense_step", "moe_step", "longseq_full", "longseq_flash",
+        "flagship", "baseline", "compute", "attention", "attention_op",
+        "vit_compute", "compute_fused", "compute_b512", "compute_b128")
 print(",".join(k for k in legs if k not in doc))
 EOF
 )

@@ -54,8 +54,10 @@ def test_bench_always_prints_one_json_line(tmp_path):
     # with a parseable JSON line (the driver artifact contract).
     env = _scrubbed_env()
     env["BENCH_TOTAL_BUDGET_S"] = "20"
-    # keep test-noise out of the committed round-evidence log
+    # keep test-noise out of the committed round-evidence log and out of
+    # the real full-record dump a prior driver line may point at
     env["BENCH_ATTEMPTS_PATH"] = str(tmp_path / "attempts.jsonl")
+    env["BENCH_FULL_FINAL_PATH"] = str(tmp_path / "full.json")
     p = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
         env=env, capture_output=True, text=True, timeout=120,
@@ -108,8 +110,7 @@ def test_emit_final_stays_compact(tmp_path, capsys, monkeypatch):
     assert "huge_extra" not in rec
     full = json.load(open(tmp_path / "full.json"))
     assert full["huge_extra"]["blob"].startswith("y")
-    assert rec["full_record"].endswith("full.json") or \
-        rec["full_record"].endswith(".json")
+    assert rec["full_record"].endswith("full.json")
 
 
 def test_committed_tpu_evidence_is_valid_json():

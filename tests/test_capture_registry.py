@@ -37,6 +37,21 @@ def test_capture_legs_reference_real_bench_functions():
                 f"leg {leg!r} references missing bench.{fn}")
 
 
+def test_derive_folds_point_pairs_into_ratio_rows():
+    mod = _load_capture_tpu()
+    doc = {"dense_step": {"images_per_sec_per_chip": 1000.0},
+           "longseq_full": {"calls_per_sec": 2.0}}
+    mod._derive(doc)
+    # partial pairs derive nothing
+    assert "moe_vs_dense" not in doc and "flash_longseq" not in doc
+    doc["moe_step"] = {"images_per_sec_per_chip": 800.0}
+    doc["longseq_flash"] = {"calls_per_sec": 5.0, "shape": [1, 8192, 8, 128]}
+    mod._derive(doc)
+    assert doc["moe_vs_dense"]["moe_overhead"] == 1.25
+    assert doc["flash_longseq"]["flash_speedup"] == 2.5
+    assert doc["flash_longseq"]["shape"] == [1, 8192, 8, 128]
+
+
 def test_capture_loop_targets_are_registered_legs():
     """Every leg name the retry loop can request must exist in _LEG_CODE —
     a stale name would make capture_tpu skip it every iteration, silently
