@@ -698,6 +698,25 @@ def _bench_moe_step() -> dict:
     return _vit_step_point("vit_moe_s4")
 
 
+def _bench_attention_causal() -> dict:
+    """Causal flash at the attention_op shape (T=2048, bf16, B=4, H=8,
+    D=128): the decoder-regime row. The kernel skips above-diagonal tiles
+    via pl.when, so this should beat the non-causal flash row by up to 2x;
+    capture_tpu._derive folds the measured ratio once both rows exist.
+    Same q/k/v seed as attention_op for comparability; one compile per
+    child."""
+    from tpu_ddp.ops.flash_attention import flash_attention
+
+    B, T, H, D = 4, 2048, 8, 128
+    q, k, v = _attn_qkv(B, T, H, D, seed=3)
+    rate = _time_attn_impl(
+        lambda a, b, c: flash_attention(a, b, c, causal=True), q, k, v)
+    return {
+        "shape": [B, T, H, D], "dtype": "bfloat16", "impl": "flash_causal",
+        "calls_per_sec": round(rate, 2),
+    }
+
+
 def _longseq_point(impl_name: str) -> dict:
     """ONE T=8192 attention fwd+bwd timing point — SP's on-chip measurement
     (round-4 verdict item 10). T=8192 is the per-device ring tile of the

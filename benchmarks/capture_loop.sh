@@ -44,6 +44,7 @@ except Exception:
 # first close-paren.
 legs = ("compute_imagenet", "compute_wrn",
         "dense_step", "moe_step", "longseq_full", "longseq_flash",
+        "attention_causal",
         "flagship", "baseline", "compute", "attention", "attention_op",
         "vit_compute", "compute_fused", "compute_b512", "compute_b128")
 print(",".join(k for k in legs if k not in doc))

@@ -81,6 +81,10 @@ _LEG_CODE = {
                     "bench._bench_longseq_full()))",
     "longseq_flash": "import bench; print(__import__('json').dumps("
                      "bench._bench_longseq_flash()))",
+    # Round-5 causal row (verdict item 3): decoder-regime flash at the
+    # attention_op shape; _derive computes the causal-vs-noncausal ratio.
+    "attention_causal": "import bench; print(__import__('json').dumps("
+                        "bench._bench_attention_causal()))",
     "sweep_k32_b256": "import bench; print(__import__('json').dumps("
                       "bench._bench_flagship_point(32, 256)))",
     "sweep_k128_b32": "import bench; print(__import__('json').dumps("
@@ -168,6 +172,12 @@ def _derive(doc: dict) -> None:
             "flash_calls_per_sec": flash,
             "flash_speedup": round(flash / full, 3),
         }
+    causal = (doc.get("attention_causal") or {}).get("calls_per_sec")
+    noncausal = (doc.get("attention_op") or {}).get("flash_calls_per_sec")
+    if causal and noncausal:
+        # block-skipping of the upper triangle: expect up to 2x
+        doc["attention_causal"]["causal_speedup_vs_noncausal"] = round(
+            causal / noncausal, 3)
 
 
 def _write_doc(doc: dict) -> None:

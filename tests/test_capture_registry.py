@@ -50,6 +50,10 @@ def test_derive_folds_point_pairs_into_ratio_rows():
     assert doc["moe_vs_dense"]["moe_overhead"] == 1.25
     assert doc["flash_longseq"]["flash_speedup"] == 2.5
     assert doc["flash_longseq"]["shape"] == [1, 8192, 8, 128]
+    doc["attention_causal"] = {"calls_per_sec": 30.0}
+    doc["attention_op"] = {"flash_calls_per_sec": 20.0}
+    mod._derive(doc)
+    assert doc["attention_causal"]["causal_speedup_vs_noncausal"] == 1.5
 
 
 def test_capture_loop_targets_are_registered_legs():

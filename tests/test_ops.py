@@ -104,6 +104,132 @@ def test_flash_fallback_path_gradients():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+def _pad_mask(B, T, dead_rows=True):
+    """(B, T) f32 kv mask with ragged lengths; batch 1 also masks a PREFIX
+    so (with causal) some query rows see no key at all — the dead-row path."""
+    m = np.ones((B, T), np.float32)
+    m[0, 3 * T // 4:] = 0
+    if dead_rows:
+        m[1, :T // 4] = 0
+    return jnp.asarray(m)
+
+
+def test_flash_causal_matches_reference():
+    """Causal fwd + bwd vs the masked jnp reference on a multi-block tiling
+    (above-diagonal tiles are SKIPPED in-kernel; diagonal tiles masked
+    in-register)."""
+    q, k, v = _qkv(B=2, T=256, H=2, D=64, seed=7)
+
+    out = flash_attention(q, k, v, 64, 64, True, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_reference(q, k, v, causal=True)),
+        atol=2e-5,
+    )
+    g_flash = jax.grad(
+        lambda a, b, c: flash_attention(a, b, c, 64, 64, True,
+                                        causal=True).sum(), (0, 1, 2)
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda a, b, c: _reference(a, b, c, causal=True).sum(), (0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_flash_kv_mask_matches_reference():
+    """Key-padding mask fwd + bwd, including rows with zero visible keys
+    (output must be exactly 0 with zero gradient, not NaN)."""
+    q, k, v = _qkv(B=2, T=256, H=2, D=64, seed=8)
+    mask = _pad_mask(2, 256, dead_rows=False)
+
+    out = flash_attention(q, k, v, 64, 64, True, kv_mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_reference(q, k, v, kv_mask=mask)),
+        atol=2e-5,
+    )
+    g_flash = jax.grad(
+        lambda a, b, c: flash_attention(a, b, c, 64, 64, True,
+                                        kv_mask=mask).sum(), (0, 1, 2)
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda a, b, c: _reference(a, b, c, kv_mask=mask).sum(), (0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+
+
+def test_flash_causal_plus_mask_dead_rows_exact_zero():
+    """causal + prefix-masked keys: early query rows of batch 1 see NO key.
+    Their output and their gradients must be exact zeros (the
+    multiplicative-mask convention), and everything else must match the
+    reference."""
+    B, T = 2, 256
+    q, k, v = _qkv(B=B, T=T, H=2, D=64, seed=9)
+    mask = _pad_mask(B, T, dead_rows=True)
+
+    out = flash_attention(q, k, v, 64, 64, True, causal=True, kv_mask=mask)
+    ref = _reference(q, k, v, causal=True, kv_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # rows < T//4 of batch 1 are dead under causal+prefix-mask: exact 0
+    dead = np.asarray(out)[1, : T // 4]
+    assert np.all(dead == 0.0), "dead rows must be exactly zero"
+    g_flash = jax.grad(
+        lambda a, b, c: flash_attention(
+            a, b, c, 64, 64, True, causal=True, kv_mask=mask).sum(),
+        (0, 1, 2),
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda a, b, c: _reference(a, b, c, causal=True,
+                                   kv_mask=mask).sum(), (0, 1, 2)
+    )(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        assert np.all(np.isfinite(np.asarray(a)))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5)
+    assert np.all(np.asarray(g_flash[0])[1, : T // 4] == 0.0)
+
+
+def test_flash_causal_fallback_path():
+    """Prime T: the jnp fallback must honor causal + kv_mask in both
+    directions too (same dispatch contract as the kernel path)."""
+    from tpu_ddp.ops.flash_attention import _plan
+
+    q, k, v = _qkv(B=1, T=67, H=1, D=32, seed=10)
+    assert _plan(q.shape, 64, 64) is None
+    mask = jnp.asarray(np.r_[np.ones(50, np.float32), np.zeros(17, np.float32)][None])
+
+    out = flash_attention(q, k, v, 64, 64, True, causal=True, kv_mask=mask)
+    ref = _reference(q, k, v, causal=True, kv_mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    g_f = jax.grad(lambda a, b, c: flash_attention(
+        a, b, c, 64, 64, True, causal=True, kv_mask=mask).sum(), (0, 1, 2)
+    )(q, k, v)
+    g_r = jax.grad(lambda a, b, c: _reference(
+        a, b, c, causal=True, kv_mask=mask).sum(), (0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_flash_causal_lowers_to_mosaic_for_tpu():
+    """The causal/masked kernels must still lower to Mosaic for TPU with
+    the same program structure as the non-causal path (1 fwd, 3 bwd).
+    Block 128 = the default compiled configuration; smaller kv blocks fail
+    _mask_tileable's minor-dim rule and deliberately fall back to jnp."""
+    q, k, v = _qkv(T=256)
+    mask = _pad_mask(2, 256, dead_rows=False)
+
+    fwd = lambda a, b, c: flash_attention(a, b, c, 128, 128, False,
+                                          causal=True, kv_mask=mask)
+    text = jax.jit(fwd).trace(q, k, v).lower(
+        lowering_platforms=("tpu",)
+    ).as_text()
+    assert text.count("stablehlo.custom_call @tpu_custom_call") == 1
+    grad = jax.grad(lambda a, b, c: fwd(a, b, c).sum(), (0, 1, 2))
+    text_bwd = jax.jit(grad).trace(q, k, v).lower(
+        lowering_platforms=("tpu",)
+    ).as_text()
+    assert text_bwd.count("stablehlo.custom_call @tpu_custom_call") == 3
+
+
 def test_interpret_gate_uses_device_kind(monkeypatch):
     """The interpret default must key on the physical device kind, not the
     backend *name*: experimental TPU platform plugins register under other

@@ -19,6 +19,15 @@ is the standard two-kernel split: a dQ kernel iterating kv-blocks innermost
 innermost — both recompute p = exp(s - lse) tile-by-tile instead of
 materializing the (T, T) probability matrix. Degenerate tilings (tiny or
 prime T) fall back to the fused jnp reference in both directions.
+
+Masking (round-4 verdict item 3, the decoder regime): ``causal=True``
+skips tiles entirely above the diagonal via ``pl.when`` (~half the MXU
+work at large T) and masks diagonal-straddling tiles in-register;
+``kv_mask`` (B, Tk) handles key padding via a sublane-broadcast
+(B*H, 8, Tk) slab applied multiplicatively to p, so rows with no visible
+key output exactly 0 with zero gradients (the ``NEG`` finite -inf + safe
+l/lse discipline below). Both compose, both differentiate through the
+Pallas backward kernels.
 """
 
 from __future__ import annotations
@@ -35,23 +44,85 @@ LANE = 128
 # Below this block size the Pallas grid degenerates (per-row kernel launches);
 # fall back to the fused jnp reference instead.
 _MIN_BLOCK = 8
+# Finite stand-in for -inf on masked logits: exp(NEG - finite_max)
+# underflows to exactly 0.0 in f32, while (-inf) - (-inf) would be NaN when
+# an entire tile row is masked.
+NEG = -1e30
 
 
-def _reference(q, k, v):
+def _bhqk_visibility(Tq: int, Tk: int, causal: bool, kv_mask):
+    """(…, Tq, Tk)-broadcastable bool visibility for full-tile jnp paths
+    ((B,H,Tq,Tk) score layouts), or None when everything is visible. The
+    ONE implementation shared by _reference and the ring's jnp tile/bwd
+    fallbacks — these must stay numerically identical to each other (and
+    to the kernels' per-tile _tile_visibility)."""
+    vis = None
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (Tq, Tk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1)
+        vis = (cols <= rows)[None, None]
+    if kv_mask is not None:
+        km = (kv_mask > 0)[:, None, None, :]
+        vis = km if vis is None else jnp.logical_and(vis, km)
+    return vis
+
+
+def _reference(q, k, v, causal: bool = False, kv_mask=None):
+    """Fused jnp attention, the numerics ground truth for the kernels.
+    ``causal`` masks col > row (self-aligned square tiles); ``kv_mask``
+    (B, Tk), nonzero = attend, masks key/value columns. Rows with no
+    visible key (possible under kv_mask) output exactly 0 — the
+    multiplicative-mask convention the kernels implement."""
     scale = 1.0 / np.sqrt(q.shape[-1])
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    vis = _bhqk_visibility(s.shape[-2], s.shape[-1], causal, kv_mask)
+    if vis is not None:
+        s = jnp.where(vis, s, NEG)
     p = jax.nn.softmax(s, axis=-1)
+    if vis is not None:
+        # all-NEG rows softmax to uniform garbage; the multiplicative mask
+        # turns them into exact zeros
+        p = p * vis
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-            scale: float, n_k: int):
+def _tile_visibility(s_shape, q_blk: int, kv_blk: int, causal: bool,
+                     mask_row):
+    """(bq, bk) bool visibility for one tile, or None when everything is
+    visible. ``q_blk``/``kv_blk`` are the grid indices of the tile;
+    ``mask_row`` is the (1, bk) f32 kv-mask slab or None."""
+    bq, bk = s_shape
+    vis = None
+    if causal:
+        rows = q_blk * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = kv_blk * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        vis = cols <= rows
+    if mask_row is not None:
+        mvis = mask_row > 0.0  # (1, bk) broadcasts over rows
+        vis = mvis if vis is None else jnp.logical_and(vis, mvis)
+    return vis
+
+
+def _kernel(q_ref, k_ref, v_ref, *rest, scale: float, n_k: int, bq: int,
+            bk: int, causal: bool, has_mask: bool):
     """One (q-block, kv-block) tile. The kv-block index is the innermost
     grid dim, so for a fixed q block the kernel runs n_k times back-to-back
     with VMEM scratch (acc/m/l) carrying the online-softmax state — only one
     (bq, d) + (bk, d) tile pair is resident per step; K/V stream from HBM
     block-by-block via the BlockSpec pipeline. The final tile also writes
-    the row logsumexp (lane-broadcast) — the backward's residual."""
+    the row logsumexp (lane-broadcast) — the backward's residual.
+
+    ``causal`` skips tiles entirely above the diagonal via pl.when (the
+    matmuls are predicated out; the BlockSpec copies still stream) and
+    masks the diagonal-straddling tiles in-register. ``has_mask`` threads a
+    (1, bk) kv-mask slab applied multiplicatively to p, so fully-masked
+    rows accumulate exact zeros (l == 0, handled at finalize)."""
+    if has_mask:
+        mask_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+        mask_ref = None
+    j = pl.program_id(1)
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -60,26 +131,49 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         l_ref[:] = jnp.zeros(l_ref.shape, jnp.float32)
         acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
 
-    q = q_ref[0]  # (bq, d)
-    s = jnp.dot(q, k_ref[0].T, preferred_element_type=jnp.float32) * scale
-    m_prev = m_ref[:, 0:1]  # (bq, 1)
-    l_prev = l_ref[:, 0:1]
-    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m_prev - m_new)
-    l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
-        p, v_ref[0], preferred_element_type=jnp.float32
-    )
-    m_ref[:, 0:1] = m_new
-    l_ref[:, 0:1] = l_new
+    def _compute():
+        q = q_ref[0]  # (bq, d)
+        s = jnp.dot(q, k_ref[0].T, preferred_element_type=jnp.float32) * scale
+        vis = _tile_visibility(
+            s.shape, j, ki, causal,
+            mask_ref[0, 0:1, :] if has_mask else None,
+        )
+        if vis is not None:
+            s = jnp.where(vis, s, NEG)
+        m_prev = m_ref[:, 0:1]  # (bq, 1)
+        l_prev = l_ref[:, 0:1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if has_mask:
+            # all-masked-so-far rows have m_new == NEG and p == exp(0) == 1
+            # on masked entries; the multiplicative mask restores exact 0
+            p = p * vis
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            p, v_ref[0], preferred_element_type=jnp.float32
+        )
+        m_ref[:, 0:1] = m_new
+        l_ref[:, 0:1] = l_new
+
+    if causal:
+        # tiles entirely above the diagonal contribute nothing: skip the
+        # matmuls (roughly half the MXU work at large T)
+        pl.when(ki * bk < (j + 1) * bq)(_compute)
+    else:
+        _compute()
 
     @pl.when(ki == n_k - 1)
     def _finalize():
-        o_ref[0] = (acc_ref[:] / l_ref[:, 0:1]).astype(o_ref.dtype)
-        lse_ref[0] = jnp.broadcast_to(
-            m_ref[:, 0:1] + jnp.log(l_ref[:, 0:1]), lse_ref.shape[1:]
-        )
+        l = l_ref[:, 0:1]
+        if has_mask:
+            safe_l = jnp.where(l > 0, l, 1.0)
+            o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+            lse = jnp.where(l > 0, m_ref[:, 0:1] + jnp.log(safe_l), NEG)
+        else:
+            o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+            lse = m_ref[:, 0:1] + jnp.log(l)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _plan(q_shape, block_q: int, block_k: int):
@@ -130,7 +224,28 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _flash_forward(q, k, v, *, block_q: int, block_k: int, interpret: bool):
+_SUBLANES = 8
+
+
+def _fold_mask(kv_mask, H: int):
+    """(B, Tk) kv mask -> (B*H, 8, Tk) f32, matching _fold's b*H + h order.
+    The sublane broadcast gives the (1, 8, bk) block a Mosaic-legal tile
+    (2D (1, bk) blocks fail the second-minor divisible-by-8 rule)."""
+    m = jnp.repeat(kv_mask.astype(jnp.float32), H, axis=0)  # (B*H, Tk)
+    return jnp.broadcast_to(m[:, None, :],
+                            (m.shape[0], _SUBLANES, m.shape[1]))
+
+
+def _mask_tileable(T: int, bk: int) -> bool:
+    """Mosaic's minor-dim rule for the (1, 8, bk) kv-mask block: the minor
+    dim must be a lane multiple or span the whole array. Callers fall back
+    to the jnp reference when the masked KERNEL path is untileable (the
+    default 128 blocks always pass)."""
+    return bk % LANE == 0 or bk == T
+
+
+def _flash_forward(q, k, v, kv_mask=None, *, block_q: int, block_k: int,
+                   interpret: bool, causal: bool = False):
     """Returns (out, lse) — lse is None on the jnp-fallback path."""
     B, T, H, D = q.shape
     scale = 1.0 / np.sqrt(D)
@@ -142,27 +257,39 @@ def _flash_forward(q, k, v, *, block_q: int, block_k: int, interpret: bool):
     # covered by the standalone tests and the real-TPU (mosaic) lowering.
     if interpret and bool(getattr(jax.typeof(q), "vma", None)):
         plan = None
+    if (plan is not None and kv_mask is not None and not interpret
+            and not _mask_tileable(T, plan[1])):
+        plan = None
     if plan is None:
-        return _reference(q, k, v), None
+        return _reference(q, k, v, causal=causal, kv_mask=kv_mask), None
     bq, bk, d_pad = plan
     qf, kf, vf = _fold(q, d_pad), _fold(k, d_pad), _fold(v, d_pad)
     n_k = T // bk
     grid = (B * H, T // bq, n_k)  # kv-block innermost: sequential carry
+    has_mask = kv_mask is not None
+    in_specs = [
+        pl.BlockSpec((1, bq, d_pad), lambda i, j, kk: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d_pad), lambda i, j, kk: (i, kk, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, bk, d_pad), lambda i, j, kk: (i, kk, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [qf, kf, vf]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((1, _SUBLANES, bk),
+                                     lambda i, j, kk: (i, 0, kk),
+                                     memory_space=pltpu.VMEM))
+        args.append(_fold_mask(kv_mask, H))
     out, lse = pl.pallas_call(
-        functools.partial(_kernel, scale=scale, n_k=n_k),
+        functools.partial(_kernel, scale=scale, n_k=n_k, bq=bq, bk=bk,
+                          causal=causal, has_mask=has_mask),
         out_shape=[
             _sds((B * H, T, d_pad), q.dtype, qf),
             _sds((B * H, T, LANE), jnp.float32, qf),
         ],
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d_pad), lambda i, j, kk: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d_pad), lambda i, j, kk: (i, kk, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d_pad), lambda i, j, kk: (i, kk, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d_pad), lambda i, j, kk: (i, j, 0),
                          memory_space=pltpu.VMEM),
@@ -175,55 +302,99 @@ def _flash_forward(q, k, v, *, block_q: int, block_k: int, interpret: bool):
             pltpu.VMEM((bq, LANE), jnp.float32),   # running denom
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*args)
     return _unfold(out, q.shape), lse
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
-               dq_acc, *, scale: float, n_k: int):
+def _tile_p(q, kb, lse_col, q_blk, kv_blk, scale, causal, mask_row):
+    """Recompute one tile's probabilities p = exp(s - lse) under the same
+    visibility the forward applied — shared by both backward kernels.
+    Masked entries are exact zeros: causal-only masking underflows
+    (lse is finite), kv-masked rows with lse == NEG are restored to 0 by
+    the multiplicative mask. Returns (p, s-visibility applied)."""
+    s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+    vis = _tile_visibility(s.shape, q_blk, kv_blk, causal, mask_row)
+    if vis is not None:
+        s = jnp.where(vis, s, NEG)
+    p = jnp.exp(s - lse_col)
+    if mask_row is not None:
+        p = p * vis
+    return p
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, *rest,
+               scale: float, n_k: int, bq: int, bk: int, causal: bool,
+               has_mask: bool):
     """dQ: for a fixed q block, stream kv blocks (innermost grid dim) and
     accumulate ds @ k in VMEM scratch; p is recomputed from the saved row
-    logsumexp, never materialized beyond one (bq, bk) tile."""
+    logsumexp, never materialized beyond one (bq, bk) tile. Causal skips
+    above-diagonal tiles like the forward."""
+    if has_mask:
+        mask_ref, dq_ref, dq_acc = rest
+    else:
+        dq_ref, dq_acc = rest
+        mask_ref = None
+    j = pl.program_id(1)
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
         dq_acc[:] = jnp.zeros(dq_acc.shape, jnp.float32)
 
-    q = q_ref[0]
-    kb = k_ref[0]
-    s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
-    p = jnp.exp(s - lse_ref[0][:, 0:1])                  # (bq, bk)
-    dp = jnp.dot(do_ref[0], v_ref[0].T,
-                 preferred_element_type=jnp.float32)      # (bq, bk)
-    ds = p * (dp - di_ref[0][:, 0:1]) * scale
-    dq_acc[:] += jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+    def _compute():
+        q = q_ref[0]
+        kb = k_ref[0]
+        p = _tile_p(q, kb, lse_ref[0][:, 0:1], j, ki, scale, causal,
+                    mask_ref[0, 0:1, :] if has_mask else None)
+        dp = jnp.dot(do_ref[0], v_ref[0].T,
+                     preferred_element_type=jnp.float32)  # (bq, bk)
+        ds = p * (dp - di_ref[0][:, 0:1]) * scale
+        dq_acc[:] += jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ki * bk < (j + 1) * bq)(_compute)
+    else:
+        _compute()
 
     @pl.when(ki == n_k - 1)
     def _finalize():
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float, n_q: int):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, *rest,
+                scale: float, n_q: int, bq: int, bk: int, causal: bool,
+                has_mask: bool):
     """dK/dV: for a fixed kv block, stream q blocks (innermost grid dim),
-    accumulating p^T @ do and ds^T @ q in VMEM scratch."""
-    qi = pl.program_id(2)
+    accumulating p^T @ do and ds^T @ q in VMEM scratch. Causal skips tiles
+    whose q rows all precede this kv block."""
+    if has_mask:
+        mask_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
+        mask_ref = None
+    j = pl.program_id(1)   # kv-block index
+    qi = pl.program_id(2)  # q-block index (innermost)
 
     @pl.when(qi == 0)
     def _init():
         dk_acc[:] = jnp.zeros(dk_acc.shape, jnp.float32)
         dv_acc[:] = jnp.zeros(dv_acc.shape, jnp.float32)
 
-    q = q_ref[0]
-    kb = k_ref[0]
-    do = do_ref[0]
-    s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
-    p = jnp.exp(s - lse_ref[0][:, 0:1])                  # (bq, bk)
-    dv_acc[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
-    dp = jnp.dot(do, v_ref[0].T, preferred_element_type=jnp.float32)
-    ds = p * (dp - di_ref[0][:, 0:1]) * scale
-    dk_acc[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+    def _compute():
+        q = q_ref[0]
+        kb = k_ref[0]
+        do = do_ref[0]
+        p = _tile_p(q, kb, lse_ref[0][:, 0:1], qi, j, scale, causal,
+                    mask_ref[0, 0:1, :] if has_mask else None)
+        dv_acc[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_ref[0].T, preferred_element_type=jnp.float32)
+        ds = p * (dp - di_ref[0][:, 0:1]) * scale
+        dk_acc[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(j * bk < (qi + 1) * bq)(_compute)
+    else:
+        _compute()
 
     @pl.when(qi == n_q - 1)
     def _finalize():
@@ -231,8 +402,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, g, *, block_q: int, block_k: int,
-                    interpret: bool):
+def _flash_backward(q, k, v, o, lse, g, kv_mask=None, *, block_q: int,
+                    block_k: int, interpret: bool, causal: bool = False):
     B, T, H, D = q.shape
     scale = 1.0 / np.sqrt(D)
     bq, bk, d_pad = _plan(q.shape, block_q, block_k)
@@ -247,6 +418,10 @@ def _flash_backward(q, k, v, o, lse, g, *, block_q: int, block_k: int,
         (B * H, T, LANE),
     )
     n_q, n_k = T // bq, T // bk
+    has_mask = kv_mask is not None
+    mask_f = _fold_mask(kv_mask, H) if has_mask else None
+    kparams = dict(scale=scale, bq=bq, bk=bk, causal=causal,
+                   has_mask=has_mask)
 
     q_spec = pl.BlockSpec((1, bq, d_pad), lambda i, j, kk: (i, j, 0),
                           memory_space=pltpu.VMEM)
@@ -254,15 +429,22 @@ def _flash_backward(q, k, v, o, lse, g, *, block_q: int, block_k: int,
                             memory_space=pltpu.VMEM)
     kv_inner = pl.BlockSpec((1, bk, d_pad), lambda i, j, kk: (i, kk, 0),
                             memory_space=pltpu.VMEM)
+    in_specs = [q_spec, kv_inner, kv_inner, q_spec, row_spec, row_spec]
+    args = [qf, kf, vf, gf, lse, di]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((1, _SUBLANES, bk),
+                                     lambda i, j, kk: (i, 0, kk),
+                                     memory_space=pltpu.VMEM))
+        args.append(mask_f)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, n_k=n_k),
+        functools.partial(_dq_kernel, n_k=n_k, **kparams),
         out_shape=_sds((B * H, T, d_pad), q.dtype, gf),
         grid=(B * H, n_q, n_k),  # kv innermost: dq carry in scratch
-        in_specs=[q_spec, kv_inner, kv_inner, q_spec, row_spec, row_spec],
+        in_specs=in_specs,
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((bq, d_pad), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, di)
+    )(*args)
 
     q_inner = pl.BlockSpec((1, bq, d_pad), lambda i, j, qq: (i, qq, 0),
                            memory_space=pltpu.VMEM)
@@ -270,21 +452,28 @@ def _flash_backward(q, k, v, o, lse, g, *, block_q: int, block_k: int,
                              memory_space=pltpu.VMEM)
     kv_spec = pl.BlockSpec((1, bk, d_pad), lambda i, j, qq: (i, j, 0),
                            memory_space=pltpu.VMEM)
+    in_specs = [q_inner, kv_spec, kv_spec, q_inner, row_inner, row_inner]
+    args = [qf, kf, vf, gf, lse, di]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((1, _SUBLANES, bk),
+                                     lambda i, j, qq: (i, 0, j),
+                                     memory_space=pltpu.VMEM))
+        args.append(mask_f)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, n_q=n_q),
+        functools.partial(_dkv_kernel, n_q=n_q, **kparams),
         out_shape=[
             _sds((B * H, T, d_pad), k.dtype, gf),
             _sds((B * H, T, d_pad), v.dtype, gf),
         ],
         grid=(B * H, n_k, n_q),  # q innermost: dk/dv carry in scratch
-        in_specs=[q_inner, kv_spec, kv_spec, q_inner, row_inner, row_inner],
+        in_specs=in_specs,
         out_specs=[kv_spec, kv_spec],
         scratch_shapes=[
             pltpu.VMEM((bk, d_pad), jnp.float32),
             pltpu.VMEM((bk, d_pad), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, gf, lse, di)
+    )(*args)
     shape = q.shape
     return _unfold(dq, shape), _unfold(dk, shape), _unfold(dv, shape)
 
@@ -300,35 +489,57 @@ def _resolve_interpret(interpret):
     return interpret
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128,
-                    interpret: bool | None = None):
-    """(B, T, H, D) non-causal attention. ``interpret`` defaults to True off
-    TPU (CPU tests) and False on TPU."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, kv_mask, block_q, block_k, interpret, causal):
     out, _ = _flash_forward(
-        q, k, v, block_q=block_q, block_k=block_k,
-        interpret=_resolve_interpret(interpret),
+        q, k, v, kv_mask, block_q=block_q, block_k=block_k,
+        interpret=_resolve_interpret(interpret), causal=causal,
     )
     return out
 
 
-def _fwd(q, k, v, block_q, block_k, interpret):
+def _fwd(q, k, v, kv_mask, block_q, block_k, interpret, causal):
     out, lse = _flash_forward(
-        q, k, v, block_q=block_q, block_k=block_k,
-        interpret=_resolve_interpret(interpret),
+        q, k, v, kv_mask, block_q=block_q, block_k=block_k,
+        interpret=_resolve_interpret(interpret), causal=causal,
     )
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, kv_mask, out, lse)
 
 
-def _bwd(block_q, block_k, interpret, res, g):
-    q, k, v, o, lse = res
+def _bwd(block_q, block_k, interpret, causal, res, g):
+    q, k, v, kv_mask, o, lse = res
     if lse is None:  # forward took the jnp fallback (no usable tiling)
-        _, vjp = jax.vjp(_reference, q, k, v)
-        return vjp(g)
-    return _flash_backward(
-        q, k, v, o, lse, g, block_q=block_q, block_k=block_k,
-        interpret=_resolve_interpret(interpret),
-    )
+        _, vjp = jax.vjp(
+            lambda a, b, c: _reference(a, b, c, causal=causal,
+                                       kv_mask=kv_mask),
+            q, k, v,
+        )
+        dq, dk, dv = vjp(g)
+    else:
+        dq, dk, dv = _flash_backward(
+            q, k, v, o, lse, g, kv_mask, block_q=block_q, block_k=block_k,
+            interpret=_resolve_interpret(interpret), causal=causal,
+        )
+    dm = None if kv_mask is None else jnp.zeros_like(kv_mask)
+    return dq, dk, dv, dm
 
 
-flash_attention.defvjp(_fwd, _bwd)
+_flash.defvjp(_fwd, _bwd)
+
+
+def flash_attention(q, k, v, block_q: int = 128, block_k: int = 128,
+                    interpret: bool | None = None, *, causal: bool = False,
+                    kv_mask=None):
+    """(B, T, H, D) attention as a Pallas TPU kernel (fwd + bwd).
+
+    ``causal`` masks col > row and skips above-diagonal tiles (the decoder
+    regime — roughly half the MXU work at large T). ``kv_mask`` (B, Tk),
+    nonzero = attend, masks key/value columns (padding); rows with no
+    visible key output exactly 0, with clean zero gradients. ``interpret``
+    defaults to True off TPU (CPU tests) and False on TPU. No analog in
+    the reference (attention-free CNN, SURVEY.md §5.7); the causal/masked
+    forms cover the decoder workloads the ring-parallel long-context path
+    implies."""
+    if kv_mask is not None:
+        kv_mask = kv_mask.astype(jnp.float32)
+    return _flash(q, k, v, kv_mask, block_q, block_k, interpret, causal)
