@@ -54,6 +54,62 @@ def test_download_fetches_verifies_and_extracts(tmp_path):
     assert imgs.shape == (20, 32, 32, 3) and labels.shape == (20,)
 
 
+def test_partial_extraction_is_never_reported_complete(tmp_path):
+    """Round-4 advisor (medium): a waiter's readiness probe must not wake
+    on a half-extracted dir. The probe requires ALL marker files, and
+    extraction repairs a stale partial dir (interrupted legacy run) by
+    atomically replacing it from a fresh temp-dir extraction."""
+    from tpu_ddp.data.cifar10 import ensure_extracted, extracted_dataset_dir
+
+    data_dir = tmp_path / "data"
+    partial = data_dir / "cifar-10-batches-py"
+    partial.mkdir(parents=True)
+    (partial / "data_batch_1").write_bytes(b"truncated-garbage")
+    # only one of the two markers present -> NOT complete
+    assert extracted_dataset_dir(str(data_dir), "cifar10") is None
+
+    _fake_cifar10_tar(data_dir / "cifar-10-python.tar.gz")
+    assert ensure_extracted(str(data_dir), "cifar10")
+    # the partial dir was replaced by the full atomic extraction: the
+    # garbage marker is gone and the loader parses every batch
+    imgs, labels = load_cifar10(str(data_dir), train=True)
+    assert imgs.shape == (20, 32, 32, 3)
+    assert extracted_dataset_dir(str(data_dir), "cifar10") is not None
+    # no temp dirs left behind
+    assert not [p for p in os.listdir(data_dir) if p.startswith(".extract")]
+
+
+def test_extraction_is_atomic_rename(tmp_path, monkeypatch):
+    """The destination dir must appear only AFTER extractall finished: if
+    extractall dies mid-way, no batches dir exists (only a temp the next
+    attempt cleans up), so a polling rank can never load partial data."""
+    import tarfile as _t
+
+    from tpu_ddp.data.cifar10 import ensure_extracted, extracted_dataset_dir
+
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    _fake_cifar10_tar(data_dir / "cifar-10-python.tar.gz")
+
+    real = _t.TarFile.extractall
+    calls = {}
+
+    def dying_extractall(self, *a, **k):
+        calls["n"] = calls.get("n", 0) + 1
+        real(self, *a, **k)
+        if calls["n"] == 1:
+            raise OSError("simulated crash AFTER files hit disk")
+
+    monkeypatch.setattr(_t.TarFile, "extractall", dying_extractall)
+    with pytest.raises(OSError):
+        ensure_extracted(str(data_dir), "cifar10")
+    # crash between extractall and rename: probe must stay incomplete
+    assert extracted_dataset_dir(str(data_dir), "cifar10") is None
+    # next attempt succeeds and cleans up
+    assert ensure_extracted(str(data_dir), "cifar10")
+    assert extracted_dataset_dir(str(data_dir), "cifar10") is not None
+
+
 def test_download_rejects_checksum_mismatch(tmp_path):
     src = tmp_path / "cifar-10-python.tar.gz"
     _fake_cifar10_tar(src)

@@ -1,6 +1,7 @@
 """--keep-best: retain the best-test-accuracy checkpoint alongside the
 periodic step-keyed ones."""
 
+import dataclasses
 import json
 import os
 
@@ -36,6 +37,61 @@ def test_save_as_only_saves_before_deleting(tmp_path):
     restored = ck.restore(state)
     assert int(restored["step"]) == 9
     ck.close()
+
+
+def test_interrupted_save_as_only_sweep_is_repaired(tmp_path, monkeypatch):
+    """Round-4 advisor: a crash between save_as_only's awaited save and
+    its delete loop leaves both steps on disk; when the new best replayed
+    at an OLDER step, latest_step() (max) would restore the STALE best.
+    The intent marker makes the next construction finish the sweep."""
+    import jax.numpy as jnp
+
+    from tpu_ddp.checkpoint import Checkpointer
+
+    state = {"w": jnp.arange(4.0), "step": jnp.asarray(0)}
+    best_dir = tmp_path / "best"
+    ck = Checkpointer(str(best_dir))
+    ck.save(12, {**state, "step": jnp.asarray(12)}, wait=True)
+    # crash-window simulation: the forced save of the replayed OLDER best
+    # and the intent marker both landed, but the process died before the
+    # delete loop (and therefore before the end-of-sweep marker clear)
+    monkeypatch.setattr(ck.manager, "delete", lambda s: None)
+    monkeypatch.setattr(ck, "_clear_marker", lambda: None)
+    ck.save_as_only(9, {**state, "step": jnp.asarray(9)})
+    assert sorted(ck.manager.all_steps()) == [9, 12]
+    assert json.load(open(best_dir / "only_step.json"))["step"] == 9
+    ck.close()
+
+    ck2 = Checkpointer(str(best_dir))  # construction completes the sweep
+    assert ck2.manager.all_steps() == [9]
+    restored = ck2.restore(state)
+    assert int(restored["step"]) == 9
+    # the completed sweep clears the marker: a later PLAIN save to the
+    # same dir must survive the next construction (a lingering marker
+    # would delete it as "stale")
+    assert not (best_dir / "only_step.json").exists()
+    ck2.save(15, {**state, "step": jnp.asarray(15)}, wait=True)
+    ck2.close()
+    ck3 = Checkpointer(str(best_dir))
+    assert sorted(ck3.manager.all_steps()) == [9, 15]
+    ck3.close()
+
+
+def test_corrupt_best_metadata_tolerated_on_resume(tmp_path):
+    """A truncated best/metadata.json (preemption mid-write before the
+    write became atomic) must not kill --resume --keep-best: the best
+    accuracy resets to unset with a warning and training proceeds."""
+    ck = str(tmp_path / "ck")
+    cfg = TrainConfig(
+        synthetic_data=True, synthetic_size=64, per_shard_batch=4,
+        epochs=1, eval_each_epoch=True, checkpoint_dir=ck, keep_best=True,
+    )
+    best_dir = os.path.join(ck, "best")
+    os.makedirs(best_dir)
+    with open(os.path.join(best_dir, "metadata.json"), "w") as f:
+        f.write('{"step": 3, "test_acc')  # torn write
+    t = Trainer(dataclasses.replace(cfg, resume=True))
+    assert t._best_acc == float("-inf")
 
 
 @pytest.mark.slow  # full 3-epoch trainer run (~50s); the guard test stays fast

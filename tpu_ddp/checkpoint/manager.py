@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 from typing import Any, Optional
@@ -15,6 +16,9 @@ log = logging.getLogger(__name__)
 class Checkpointer:
     """Step-keyed checkpoints of the full TrainState."""
 
+    # intent record for save_as_only's delete sweep (see _sweep_stale)
+    _ONLY_MARKER = "only_step.json"
+
     def __init__(self, directory: str, max_to_keep: int = 3):
         self.directory = os.path.abspath(directory)
         self.manager = ocp.CheckpointManager(
@@ -23,6 +27,41 @@ class Checkpointer:
                 max_to_keep=max_to_keep, create=True
             ),
         )
+        self._sweep_stale()
+
+    def _sweep_stale(self) -> None:
+        """Finish an interrupted save_as_only sweep: a crash between the
+        awaited save and the delete loop leaves BOTH the new and old steps
+        on disk, and latest_step() (max step) would then pick the STALE old
+        best whenever the new best was replayed at an older step — exactly
+        the scenario save_as_only exists to handle. The marker records the
+        intended survivor; completing the sweep here makes latest_step()
+        trustworthy again before anyone restores."""
+        marker = os.path.join(self.directory, self._ONLY_MARKER)
+        try:
+            with open(marker) as f:
+                want = int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError):
+            return
+        steps = self.manager.all_steps()
+        if want in steps:
+            for s in steps:
+                if s != want:
+                    log.warning(
+                        "completing interrupted save_as_only sweep: "
+                        "deleting stale step %d (keeping %d)", s, want)
+                    self.manager.delete(s)
+        self._clear_marker()
+
+    def _clear_marker(self) -> None:
+        """The marker only means 'a save_as_only sweep may be mid-flight';
+        once a sweep completes it MUST go away — a lingering marker would
+        assert 'only step X may exist' forever and silently delete later
+        plain save()s to the same directory on the next construction."""
+        try:
+            os.remove(os.path.join(self.directory, self._ONLY_MARKER))
+        except OSError:
+            pass
 
     def save(self, step: int, state: Any, wait: bool = False) -> None:
         self.manager.save(step, args=ocp.args.StandardSave(state))
@@ -39,12 +78,23 @@ class Checkpointer:
         Ordering matters: the NEW checkpoint is saved and awaited (orbax
         saves are async) BEFORE the old one is deleted — delete-first
         would leave a crash window with zero best checkpoints, and could
-        race the deletion against a still-in-flight earlier save."""
+        race the deletion against a still-in-flight earlier save. The
+        intent marker lands (atomically, process 0) between the two, so a
+        crash mid-sweep is repaired by the next construction's
+        _sweep_stale instead of poisoning latest_step()."""
         self.manager.save(step, args=ocp.args.StandardSave(state), force=True)
         self.manager.wait_until_finished()
+        if jax.process_index() == 0:
+            marker = os.path.join(self.directory, self._ONLY_MARKER)
+            tmp = f"{marker}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"step": int(step)}, f)
+            os.replace(tmp, marker)
         for s in self.manager.all_steps():
             if s != step:
                 self.manager.delete(s)
+        if jax.process_index() == 0:
+            self._clear_marker()
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()

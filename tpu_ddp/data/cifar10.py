@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import shutil
 import tarfile
 from typing import Tuple
 
@@ -41,11 +42,16 @@ DATASET_LAYOUTS = {
 
 def extracted_dataset_dir(data_dir: str, dataset: str):
     """The extracted batches dir if present (the loader's own candidate
-    list), else None. Pure probe: never extracts, never raises."""
+    list), else None. Pure probe: never extracts, never raises.
+
+    ALL marker files must be present: ranks waiting on rank 0's extraction
+    poll this probe, and extraction lands atomically (temp dir + rename in
+    ``_find_dataset_dir``), so a dir holding only SOME markers is a stale
+    partial from an interrupted legacy run — never report it complete."""
     subdir, markers, _, what = DATASET_LAYOUTS[dataset]
     for c in (data_dir, os.path.join(data_dir, subdir),
               os.path.join(data_dir, what, subdir)):
-        if any(os.path.isfile(os.path.join(c, m)) for m in markers):
+        if all(os.path.isfile(os.path.join(c, m)) for m in markers):
             return c
     return None
 
@@ -78,29 +84,89 @@ def ensure_extracted(data_dir: str, dataset: str) -> bool:
 def _find_dataset_dir(
     data_dir: str, subdir: str, marker_files, tarball: str, what: str
 ) -> str:
-    """Locate an extracted dataset dir (any marker file present), or
-    auto-extract a downloaded tarball (torchvision leaves one)."""
+    """Locate an extracted dataset dir (all marker files present), or
+    auto-extract a downloaded tarball (torchvision leaves one).
+
+    Extraction is ATOMIC: the tarball extracts into a per-process temp dir
+    and the batches subdir os.rename()s into place, so a concurrent
+    waiter's probe (``extracted_dataset_dir``) can never observe a
+    half-written dir, and an interrupted extraction leaves only a temp dir
+    (cleaned up on the next attempt) instead of a partial that would
+    permanently satisfy the probe. A pre-existing INCOMPLETE destination
+    (interrupted legacy run) is replaced; a complete one (a concurrent
+    extractor won the rename) is used as-is."""
     candidates = [
         data_dir,
         os.path.join(data_dir, subdir),
         os.path.join(data_dir, what, subdir),
     ]
+
+    def complete(c: str) -> bool:
+        return all(os.path.isfile(os.path.join(c, m)) for m in marker_files)
+
     for c in candidates:
-        if any(os.path.isfile(os.path.join(c, m)) for m in marker_files):
+        if complete(c):
             return c
+    # No complete dir. If a tarball is available, extract (which also
+    # REPAIRS a partial dir from an interrupted legacy extraction); only
+    # when there is no tarball do we fall back to a partial user-placed
+    # dir below — the split loader gives a clear error if its own files
+    # are missing (eval-only placements hold just the test split).
     for c in [data_dir, os.path.join(data_dir, what)]:
         tar = os.path.join(c, tarball)
         if os.path.isfile(tar):
-            with tarfile.open(tar) as tf:
+            dst = os.path.join(c, subdir)
+            # reap temp dirs orphaned by a hard kill (SIGKILL/preemption
+            # between extractall and this attempt's own cleanup): they are
+            # pid-named, so only a sibling sweep removes them — but never
+            # one whose owning process is still alive mid-extraction
+            for stale in os.listdir(c):
+                if not stale.startswith(".extract.tmp."):
+                    continue
                 try:
-                    # "data" filter: reject absolute paths / path traversal
-                    # (and silence the 3.14 default-change warning)
-                    tf.extractall(c, filter="data")
-                except TypeError:
-                    # filter= needs >=3.12 (backported to 3.10.12/3.11.4);
-                    # pyproject supports >=3.10
-                    tf.extractall(c)
-            return os.path.join(c, subdir)
+                    os.kill(int(stale.rsplit(".", 1)[1]), 0)
+                except (ValueError, ProcessLookupError):
+                    shutil.rmtree(os.path.join(c, stale),
+                                  ignore_errors=True)
+                except PermissionError:
+                    pass  # live process under another uid: leave it
+            tmp = os.path.join(c, f".extract.tmp.{os.getpid()}")
+            try:
+                with tarfile.open(tar) as tf:
+                    try:
+                        # "data" filter: reject absolute paths / traversal
+                        # (and silence the 3.14 default-change warning)
+                        tf.extractall(tmp, filter="data")
+                    except TypeError:
+                        # filter= needs >=3.12 (backported to
+                        # 3.10.12/3.11.4); pyproject supports >=3.10
+                        tf.extractall(tmp)
+                src = os.path.join(tmp, subdir)
+                if not os.path.isdir(src):
+                    raise FileNotFoundError(
+                        f"{tar} does not contain the canonical "
+                        f"{subdir}/ layout")
+                try:
+                    os.rename(src, dst)
+                except OSError:
+                    # dst exists: complete (concurrent extractor won) ->
+                    # keep it; incomplete (interrupted legacy extraction)
+                    # -> replace with the fully-extracted copy. The
+                    # replacement itself can lose a repair race, so only
+                    # re-raise if nobody produced a complete dst.
+                    if not complete(dst):
+                        shutil.rmtree(dst, ignore_errors=True)
+                        try:
+                            os.rename(src, dst)
+                        except OSError:
+                            if not complete(dst):
+                                raise
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+            return dst
+    for c in candidates:
+        if any(os.path.isfile(os.path.join(c, m)) for m in marker_files):
+            return c
     raise FileNotFoundError(
         f"{what} batches not found under {data_dir!r} (download=False "
         f"semantics, main.py:53). Expected {subdir}/{marker_files[0]} "

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import time
 from typing import Optional
@@ -29,6 +30,8 @@ from tpu_ddp.parallel.mesh import DATA_AXIS, MeshSpec, batch_sharding, create_me
 from tpu_ddp.train.optim import make_optimizer
 from tpu_ddp.train.state import create_train_state
 from tpu_ddp.train.steps import make_eval_step, make_train_step
+
+log = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -317,9 +320,19 @@ class Trainer:
                 self.best_checkpointer = Checkpointer(best_dir, max_to_keep=1)
                 meta = os.path.join(best_dir, "metadata.json")
                 if config.resume and os.path.isfile(meta):
-                    # don't demote a resumed run's best on the first eval
-                    with open(meta) as f:
-                        self._best_acc = json.load(f)["test_accuracy"]
+                    # don't demote a resumed run's best on the first eval;
+                    # a corrupt/truncated metadata file (crash mid-write
+                    # before the writes became atomic, torn copy) falls
+                    # back to -inf with a warning instead of killing the
+                    # resume — the stored best may be re-replaced, never
+                    # silently trusted
+                    try:
+                        with open(meta) as f:
+                            self._best_acc = json.load(f)["test_accuracy"]
+                    except (OSError, ValueError, KeyError) as e:
+                        log.warning(
+                            "unreadable best metadata %s (%s); treating "
+                            "best accuracy as unset", meta, e)
             if config.resume and self.checkpointer.latest_step() is not None:
                 from tpu_ddp.parallel.mesh import replicated_sharding
 
@@ -900,11 +913,16 @@ class Trainer:
                         )
 
                         if is_primary_process():
+                            # atomic: a preemption mid-write must not
+                            # leave a truncated file for the next
+                            # --resume --keep-best run to choke on
                             meta = os.path.join(
                                 c.checkpoint_dir, "best", "metadata.json")
-                            with open(meta, "w") as f:
+                            tmp = f"{meta}.tmp.{os.getpid()}"
+                            with open(tmp, "w") as f:
                                 json.dump({"step": step_now,
                                            "test_accuracy": acc}, f)
+                            os.replace(tmp, meta)
                 else:
                     self.logger.log(int(self.state.step), test_loss=loss)
         throughput.stop(wait_for=self.state.params)
