@@ -39,11 +39,16 @@ def test_save_as_only_saves_before_deleting(tmp_path):
     ck.close()
 
 
-def test_interrupted_save_as_only_sweep_is_repaired(tmp_path, monkeypatch):
+def test_interrupted_save_as_only_marker_shadows_stale_best(
+        tmp_path, monkeypatch):
     """Round-4 advisor: a crash between save_as_only's awaited save and
     its delete loop leaves both steps on disk; when the new best replayed
     at an OLDER step, latest_step() (max) would restore the STALE best.
-    The intent marker makes the next construction finish the sweep."""
+    The intent marker (written BEFORE the save, so no crash window
+    reopens the bug) makes latest_step()/restore prefer the intended
+    survivor without any construction-time delete — orbax delete is a
+    cross-process collective, so a lone constructing process must never
+    sweep."""
     import jax.numpy as jnp
 
     from tpu_ddp.checkpoint import Checkpointer
@@ -52,9 +57,8 @@ def test_interrupted_save_as_only_sweep_is_repaired(tmp_path, monkeypatch):
     best_dir = tmp_path / "best"
     ck = Checkpointer(str(best_dir))
     ck.save(12, {**state, "step": jnp.asarray(12)}, wait=True)
-    # crash-window simulation: the forced save of the replayed OLDER best
-    # and the intent marker both landed, but the process died before the
-    # delete loop (and therefore before the end-of-sweep marker clear)
+    # crash-window simulation: marker + forced save of the replayed OLDER
+    # best landed, process died before the delete loop / marker clear
     monkeypatch.setattr(ck.manager, "delete", lambda s: None)
     monkeypatch.setattr(ck, "_clear_marker", lambda: None)
     ck.save_as_only(9, {**state, "step": jnp.asarray(9)})
@@ -62,19 +66,40 @@ def test_interrupted_save_as_only_sweep_is_repaired(tmp_path, monkeypatch):
     assert json.load(open(best_dir / "only_step.json"))["step"] == 9
     ck.close()
 
-    ck2 = Checkpointer(str(best_dir))  # construction completes the sweep
-    assert ck2.manager.all_steps() == [9]
+    ck2 = Checkpointer(str(best_dir))
+    # no sweep happened (collective-safety), but the marker shadows the
+    # stale max step for latest_step()/restore
+    assert sorted(ck2.manager.all_steps()) == [9, 12]
+    assert ck2.latest_step() == 9
     restored = ck2.restore(state)
     assert int(restored["step"]) == 9
-    # the completed sweep clears the marker: a later PLAIN save to the
-    # same dir must survive the next construction (a lingering marker
-    # would delete it as "stale")
+    # the next save_as_only completes the deferred sweep collectively
+    ck2.save_as_only(10, {**state, "step": jnp.asarray(10)})
+    assert ck2.manager.all_steps() == [10]
     assert not (best_dir / "only_step.json").exists()
-    ck2.save(15, {**state, "step": jnp.asarray(15)}, wait=True)
     ck2.close()
-    ck3 = Checkpointer(str(best_dir))
-    assert sorted(ck3.manager.all_steps()) == [9, 15]
-    ck3.close()
+
+
+def test_stale_marker_never_shadows_plain_saves(tmp_path, monkeypatch):
+    """A marker whose save never landed resolves to nothing, and a plain
+    save() clears any leftover intent — mixed usage keeps max-step
+    semantics."""
+    import jax.numpy as jnp
+
+    from tpu_ddp.checkpoint import Checkpointer
+
+    state = {"w": jnp.arange(4.0), "step": jnp.asarray(0)}
+    best_dir = tmp_path / "best"
+    best_dir.mkdir()
+    # marker for a step that never landed (crash between marker and save)
+    with open(best_dir / "only_step.json", "w") as f:
+        json.dump({"step": 7}, f)
+    ck = Checkpointer(str(best_dir))
+    assert ck.latest_step() is None  # stale marker resolves to nothing
+    ck.save(15, {**state, "step": jnp.asarray(15)}, wait=True)
+    assert not (best_dir / "only_step.json").exists()  # save cleared it
+    assert ck.latest_step() == 15
+    ck.close()
 
 
 def test_corrupt_best_metadata_tolerated_on_resume(tmp_path):

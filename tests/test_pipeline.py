@@ -109,6 +109,88 @@ def test_pp_blocks_are_physically_staged(devices):
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_1f1b_matches_gpipe_exactly(devices):
+    """Round-4 verdict item 5: the interleaved 1F1B schedule (manual
+    backward, per-stage recompute, O(S) in-flight activations) must match
+    the GPipe schedule's loss AND updated params on the 2x4 mesh — same
+    math, different order/memory."""
+    mesh = create_mesh(MeshSpec(data=2, pipeline=4), devices)
+    model = _model(depth=8)
+    tx = make_optimizer(lr=1e-2, momentum=0.9)
+    batch = _batch(16, seed=3)
+    out = {}
+    for sched in ("gpipe", "1f1b"):
+        state = create_pp_train_state(model, tx, jax.random.key(0))
+        step, shardings = make_pp_train_step(
+            model, tx, mesh, state, n_microbatches=4, schedule=sched)
+        state = jax.device_put(state, shardings)
+        new_state, metrics = step(state, batch)
+        out[sched] = (float(metrics["loss"]),
+                      jax.device_get(new_state.params))
+    assert abs(out["gpipe"][0] - out["1f1b"][0]) < 1e-6
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(out["gpipe"][1]),
+        jax.tree_util.tree_leaves_with_path(out["1f1b"][1]),
+    ):
+        assert pa == pb
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=0,
+                                   err_msg=jax.tree_util.keystr(pa))
+
+
+def test_1f1b_matches_plain_vit_grads(devices):
+    """The manual backward (ring-buffer recompute, per-micro head/embed
+    vjps, explicit psum/pmean reduction) reproduces plain autodiff's
+    gradients — the strongest pin available (ratio bugs in the manual
+    reduction showed up as exact S-x / n_data-x scalings)."""
+    import optax
+
+    mesh = create_mesh(MeshSpec(data=2, pipeline=4), devices)
+    model = _model(depth=4)
+    tx = optax.sgd(1.0)  # param delta == -grad
+    batch = _batch(16, seed=3)
+
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+
+    def loss_fn(p):
+        logits = model.apply({"params": p},
+                             jnp.asarray(batch["image"]), train=True)
+        return cross_entropy_loss(logits, jnp.asarray(batch["label"]),
+                                  jnp.asarray(batch["mask"]))
+
+    ref = to_pipeline_params(jax.grad(loss_fn)(variables["params"]),
+                             model.depth)
+    state = create_pp_train_state(model, tx, jax.random.key(0))
+    old = jax.device_get(state.params)
+    step, shardings = make_pp_train_step(
+        model, tx, mesh, state, n_microbatches=4, schedule="1f1b",
+        donate=False)
+    new_state, _ = step(jax.device_put(state, shardings), batch)
+    grads = jax.tree.map(lambda o, n: o - n, old,
+                         jax.device_get(new_state.params))
+    for (pa, g), (pb, r) in zip(
+        jax.tree_util.tree_leaves_with_path(grads),
+        jax.tree_util.tree_leaves_with_path(ref),
+    ):
+        assert pa == pb
+        np.testing.assert_allclose(g, r, atol=2e-5, rtol=0,
+                                   err_msg=jax.tree_util.keystr(pa))
+
+
+def test_pp_schedule_stats():
+    from tpu_ddp.parallel.pipeline import pp_schedule_stats
+
+    g = pp_schedule_stats(4, 8, "gpipe")
+    assert g["bubble_fraction"] == round(3 / 11, 4)
+    assert g["in_flight_microbatches"] == 8 and not g["recompute"]
+    f = pp_schedule_stats(4, 8, "1f1b")
+    assert f["bubble_fraction"] == round(6 / 14, 4)
+    # the 1F1B point: in-flight stays bounded as M grows
+    assert f["in_flight_microbatches"] == 7
+    assert pp_schedule_stats(4, 64, "1f1b")["in_flight_microbatches"] == 7
+    assert f["recompute"]
+
+
 def test_pp_pure_pipeline_mesh(devices):
     """pipeline=8, no data axis in use (data=1)."""
     mesh = create_mesh(MeshSpec(data=1, pipeline=8), devices)

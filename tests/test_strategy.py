@@ -214,6 +214,102 @@ def test_strategy_rejects_augment(devices):
         Trainer(config)
 
 
+# --------------------- memory knobs compose with the GSPMD family --
+
+def _strategy_one_step(parallelism, mesh_sizes, *, remat=False,
+                       grad_accum_steps=1):
+    """One train step of vit_s4 under the given strategy on a FIXED batch;
+    returns (params after the step, task loss). Same rng seed everywhere,
+    so any two configurations with identical math must agree."""
+    import jax
+
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+    from tpu_ddp.parallel import MeshSpec, create_mesh
+    from tpu_ddp.train import make_optimizer
+    from tpu_ddp.train.strategy import build_strategy
+
+    model = MODEL_REGISTRY["vit_s4"](num_classes=10)  # no BN: accum-exact
+    tx = make_optimizer(lr=0.1, momentum=0.9)
+    mesh = create_mesh(MeshSpec(**mesh_sizes))
+    strategy = build_strategy(
+        parallelism, mesh, model, tx, jax.random.key(0),
+        remat=remat, grad_accum_steps=grad_accum_steps,
+    )
+    from tpu_ddp.data import synthetic_cifar10
+
+    imgs, labels = synthetic_cifar10(16, seed=5)
+    batch = {"image": imgs.astype(np.float32), "label": labels,
+             "mask": np.ones(16, bool)}
+    batch = {k: jax.device_put(v, strategy.batch_shardings[k])
+             for k, v in batch.items()}
+    new_state, metrics = strategy.train_step(strategy.state, batch)
+    return (jax.device_get(new_state.params),
+            float(np.asarray(metrics["loss"])))
+
+
+def test_fsdp_remat_matches_unsharded_math(devices):
+    """--remat under fsdp (round-4 verdict item 4): rematerialization must
+    not change the math — params after one step match the plain fsdp step
+    bit-for-bit up to float tolerance."""
+    base_params, base_loss = _strategy_one_step("fsdp", {"data": 8})
+    remat_params, remat_loss = _strategy_one_step(
+        "fsdp", {"data": 8}, remat=True)
+    assert remat_loss == pytest.approx(base_loss, abs=1e-6)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(base_params)[0],
+        jax.tree_util.tree_flatten_with_path(remat_params)[0],
+    ):
+        assert pa == pb
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, err_msg=str(pa))
+
+
+def test_tp_grad_accum_matches_full_batch(devices):
+    """--grad-accum-steps under tp: accumulating 4 microbatches and
+    applying ONE update must match the full-batch tp step (equal real
+    counts per microbatch -> exactly the same mean gradient)."""
+    base_params, base_loss = _strategy_one_step(
+        "tp", {"data": 2, "model": 4})
+    acc_params, acc_loss = _strategy_one_step(
+        "tp", {"data": 2, "model": 4}, grad_accum_steps=4)
+    assert acc_loss == pytest.approx(base_loss, abs=1e-5)
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(base_params)[0],
+        jax.tree_util.tree_flatten_with_path(acc_params)[0],
+    ):
+        assert pa == pb
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, err_msg=str(pa))
+
+
+def test_fsdp_grad_accum_with_remat_runs(devices):
+    """Both knobs together under fsdp — the configuration that needs
+    memory tricks most (big model, scattered state) — trains finitely."""
+    params, loss = _strategy_one_step(
+        "fsdp", {"data": 8}, remat=True, grad_accum_steps=2)
+    assert np.isfinite(loss)
+
+
+def test_pp_sp_still_reject_memory_knobs(devices):
+    """pp/sp own their microbatching/remat story; the knobs raise there
+    with a message naming the mode."""
+    import jax
+
+    from tpu_ddp.models.zoo import MODEL_REGISTRY
+    from tpu_ddp.parallel import MeshSpec, create_mesh
+    from tpu_ddp.train import make_optimizer
+    from tpu_ddp.train.strategy import build_strategy
+
+    model = MODEL_REGISTRY["vit_s4"](num_classes=10)
+    tx = make_optimizer(lr=0.1)
+    for mode, sizes in (("pp", {"data": 2, "pipeline": 4}),
+                        ("sp", {"data": 4, "sequence": 2})):
+        mesh = create_mesh(MeshSpec(**sizes))
+        with pytest.raises(ValueError, match=mode):
+            build_strategy(mode, mesh, model, tx, jax.random.key(0),
+                           remat=True)
+
+
 def test_pp_finetune_from_plain_checkpoint(tmp_path):
     """The §2.4 fine-tune capability (ppe_main_ddp.py:104-111) under the
     pipeline strategy: a plain-layout ViT checkpoint (trained under dp)

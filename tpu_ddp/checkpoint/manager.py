@@ -27,43 +27,30 @@ class Checkpointer:
                 max_to_keep=max_to_keep, create=True
             ),
         )
-        self._sweep_stale()
 
-    def _sweep_stale(self) -> None:
-        """Finish an interrupted save_as_only sweep: a crash between the
-        awaited save and the delete loop leaves BOTH the new and old steps
-        on disk, and latest_step() (max step) would then pick the STALE old
-        best whenever the new best was replayed at an older step — exactly
-        the scenario save_as_only exists to handle. The marker records the
-        intended survivor; completing the sweep here makes latest_step()
-        trustworthy again before anyone restores."""
-        marker = os.path.join(self.directory, self._ONLY_MARKER)
+    def _marker_step(self) -> Optional[int]:
+        """The save_as_only intent marker's step, if it names a step that
+        actually exists on disk; else None. A stale marker whose save
+        never landed (crash between marker write and the save) resolves
+        to None and is harmless."""
         try:
-            with open(marker) as f:
+            with open(os.path.join(self.directory, self._ONLY_MARKER)) as f:
                 want = int(json.load(f)["step"])
         except (OSError, ValueError, KeyError):
-            return
-        steps = self.manager.all_steps()
-        if want in steps:
-            for s in steps:
-                if s != want:
-                    log.warning(
-                        "completing interrupted save_as_only sweep: "
-                        "deleting stale step %d (keeping %d)", s, want)
-                    self.manager.delete(s)
-        self._clear_marker()
+            return None
+        return want if want in self.manager.all_steps() else None
 
     def _clear_marker(self) -> None:
-        """The marker only means 'a save_as_only sweep may be mid-flight';
-        once a sweep completes it MUST go away — a lingering marker would
-        assert 'only step X may exist' forever and silently delete later
-        plain save()s to the same directory on the next construction."""
-        try:
-            os.remove(os.path.join(self.directory, self._ONLY_MARKER))
-        except OSError:
-            pass
+        if jax.process_index() == 0:
+            try:
+                os.remove(os.path.join(self.directory, self._ONLY_MARKER))
+            except OSError:
+                pass
 
     def save(self, step: int, state: Any, wait: bool = False) -> None:
+        # a plain save declares max-step retention meaningful again: drop
+        # any leftover save_as_only intent so it can't shadow this step
+        self._clear_marker()
         self.manager.save(step, args=ocp.args.StandardSave(state))
         if wait:
             self.manager.wait_until_finished()
@@ -75,29 +62,36 @@ class Checkpointer:
         at a step older than the recorded one — plain save() would either
         collide on an existing step or lose the new best to retention.
 
-        Ordering matters: the NEW checkpoint is saved and awaited (orbax
-        saves are async) BEFORE the old one is deleted — delete-first
-        would leave a crash window with zero best checkpoints, and could
-        race the deletion against a still-in-flight earlier save. The
-        intent marker lands (atomically, process 0) between the two, so a
-        crash mid-sweep is repaired by the next construction's
-        _sweep_stale instead of poisoning latest_step()."""
-        self.manager.save(step, args=ocp.args.StandardSave(state), force=True)
-        self.manager.wait_until_finished()
+        Crash-safety: the intent marker lands FIRST (atomically, process
+        0), then the new checkpoint is saved and awaited (orbax saves are
+        async) BEFORE the old ones are deleted — delete-first would leave
+        a crash window with zero best checkpoints. A crash anywhere in
+        between leaves either a marker naming a step that never landed
+        (ignored and cleared later) or both steps plus a marker naming the
+        survivor — which ``latest_step`` then prefers over the stale max
+        step, with the actual delete deferred to the next save_as_only
+        (orbax delete is a cross-process collective, so no construction-
+        time sweep: a lone process sweeping would hang the barrier)."""
         if jax.process_index() == 0:
             marker = os.path.join(self.directory, self._ONLY_MARKER)
             tmp = f"{marker}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
                 json.dump({"step": int(step)}, f)
             os.replace(tmp, marker)
+        self.manager.save(step, args=ocp.args.StandardSave(state), force=True)
+        self.manager.wait_until_finished()
         for s in self.manager.all_steps():
             if s != step:
                 self.manager.delete(s)
-        if jax.process_index() == 0:
-            self._clear_marker()
+        self._clear_marker()
 
     def latest_step(self) -> Optional[int]:
-        return self.manager.latest_step()
+        """Newest meaningful step: a pending save_as_only intent marker
+        (interrupted sweep) overrides the max-step rule — the marker's
+        step IS the logically-latest checkpoint even when a stale older
+        save still sits at a higher step number."""
+        marked = self._marker_step()
+        return marked if marked is not None else self.manager.latest_step()
 
     def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
         """Restore into the structure/shardings of `state_template`."""

@@ -75,8 +75,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(axes: data, pipeline, expert, sequence, model; "
                         "-1 = rest). Naming a non-data axis infers the "
                         "matching --parallelism")
-    p.add_argument("--microbatches", type=int, default=2,
-                   help="GPipe microbatches per step (pp only)")
+    p.add_argument("--microbatches", type=int, default=4,
+                   help="pipeline microbatches per step (pp only); more "
+                        "microbatches = smaller bubble, and under "
+                        "--pp-schedule 1f1b activation memory stays O(S) "
+                        "regardless")
+    p.add_argument("--pp-schedule", choices=["gpipe", "1f1b"],
+                   default="gpipe",
+                   help="pipeline schedule (pp only): gpipe = autodiff "
+                        "backward, O(M) stored activations; 1f1b = "
+                        "interleaved manual backward with per-stage "
+                        "recompute, O(S) in-flight activations")
     p.add_argument("--aux-weight", type=float, default=0.01,
                    help="MoE load-balance loss weight (MoE models only)")
     p.add_argument("--model", default="netresdeep")
@@ -105,8 +114,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bfloat16 runs the forward/backward on the MXU at "
                         "2x throughput; params/loss stay f32")
     p.add_argument("--remat", action="store_true",
-                   help="rematerialize the forward in backward "
-                        "(jax.checkpoint): fits deeper models in HBM")
+                   help="rematerialize the forward in backward: fits "
+                        "deeper models in HBM (per-block for the ViT/MoE "
+                        "families; composes with dp/fsdp/tp/fsdp_tp/ep)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--augment", action="store_true",
                    help="on-device random crop+flip (the reference has no "
@@ -191,7 +201,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help=">1 splits each optimizer step into K sequential "
                         "microbatches (gradient accumulation): same "
                         "semantics, ~1/K activation memory — the big-"
-                        "global-batch knob")
+                        "global-batch knob (composes with "
+                        "dp/fsdp/tp/fsdp_tp/ep)")
     p.add_argument("--prefetch-depth", type=int, default=2,
                    help="batches assembled ahead on the native host "
                         "prefetcher (C++ ring buffer; 0 disables)")
@@ -272,6 +283,7 @@ def config_from_args(args) -> TrainConfig:
         parallelism=args.parallelism,
         mesh=mesh_sizes,
         n_microbatches=args.microbatches,
+        pp_schedule=args.pp_schedule,
         aux_weight=args.aux_weight,
         seed=args.seed,
         shuffle=not args.no_shuffle,

@@ -158,6 +158,9 @@ class MoEViT(nn.Module):
     moe_every: int = 2
     capacity_factor: float = 1.25
     mlp_ratio: int = 4
+    # per-block rematerialization, same convention as vit.ViT.remat
+    # (param trees are identical either way)
+    remat: bool = False
     dtype: jnp.dtype = jnp.float32
     # interface parity with the CNN zoo; a ViT has no BN
     bn_cross_replica_axis: Optional[str] = None
@@ -178,23 +181,27 @@ class MoEViT(nn.Module):
             (1, x.shape[1], self.hidden_dim),
         )
         x = x + pos.astype(x.dtype)
+        moe_cls, dense_cls = MoETransformerBlock, TransformerBlock
+        if self.remat:
+            moe_cls = nn.remat(MoETransformerBlock, static_argnums=(2,))
+            dense_cls = nn.remat(TransformerBlock, static_argnums=(2,))
         for i in range(self.depth):
             if self.moe_every and (i + 1) % self.moe_every == 0:
-                x = MoETransformerBlock(
+                x = moe_cls(
                     self.num_heads,
                     num_experts=self.num_experts,
                     capacity_factor=self.capacity_factor,
                     mlp_ratio=self.mlp_ratio,
                     dtype=self.dtype,
                     name=f"block_{i}",
-                )(x, train=train)
+                )(x, train)
             else:
-                x = TransformerBlock(
+                x = dense_cls(
                     self.num_heads,
                     mlp_ratio=self.mlp_ratio,
                     dtype=self.dtype,
                     name=f"block_{i}",
-                )(x, train=train)
+                )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         x = x.mean(axis=1)
         x = nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x)

@@ -102,6 +102,13 @@ class ViT(nn.Module):
     # (VMEM tiles) instead of the fused-jnp score tile — the long-context
     # configuration (parallel/ring_attention.py::ring_flash_attention)
     sp_flash: bool = False
+    # PER-BLOCK rematerialization: each TransformerBlock recomputes its
+    # internals in the backward, so only block BOUNDARY activations are
+    # stored — the granularity that actually shrinks peak HBM (a single
+    # whole-forward jax.checkpoint rematerializes everything at once and
+    # saves nothing; measured in tools/memplan.py). Param names/shapes are
+    # identical either way, so checkpoints are interchangeable.
+    remat: bool = False
     dtype: jnp.dtype = jnp.float32
     # kept for CLI/model-zoo interface parity with the CNNs; ViT has no BN
     bn_cross_replica_axis: Optional[str] = None
@@ -152,14 +159,17 @@ class ViT(nn.Module):
             attention_impl = self.attention_impl
 
         x = x + pos.astype(x.dtype)
+        # static_argnums=(2,): `train` is a Python bool, not a tracer
+        block_cls = (nn.remat(TransformerBlock, static_argnums=(2,))
+                     if self.remat else TransformerBlock)
         for i in range(self.depth):
-            x = TransformerBlock(
+            x = block_cls(
                 self.num_heads,
                 mlp_ratio=self.mlp_ratio,
                 attention_impl=attention_impl,
                 dtype=self.dtype,
                 name=f"block_{i}",
-            )(x, train=train)
+            )(x, train)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         x = x.mean(axis=1)  # mean-pool: SP-friendly (a pmean over sequence)
         if self.sp_axis is not None:

@@ -49,6 +49,8 @@ def make_ep_train_step(
     data_axis: str = DATA_AXIS,
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
+    remat: bool = False,
+    grad_accum_steps: int = 1,
 ):
     """Expert-parallel (optionally DP x EP) MoE train step.
 
@@ -62,6 +64,7 @@ def make_ep_train_step(
     build = make_sharded_train_step(
         model, tx, mesh, param_specs,
         data_axis=data_axis, loss_fn=loss_fn, donate=donate,
-        aux_weight=aux_weight,
+        aux_weight=aux_weight, remat=remat,
+        grad_accum_steps=grad_accum_steps,
     )
     return build(state_template)

@@ -50,7 +50,8 @@ def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
          momentum: float = 0.9, ema_decay: float = 0.0,
          image_size: int | None = None,
          num_classes: int | None = None,
-         parallelism: str = "dp", axis_size: int | None = None) -> dict:
+         parallelism: str = "dp", axis_size: int | None = None,
+         grad_accum_steps: int = 1) -> dict:
     """Compile the DP train step for ``topology`` and return the memory
     report dict. Raises on compile failure (a real regression).
 
@@ -85,7 +86,7 @@ def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
             remat=remat, topology=topology, n_devices=n_devices,
             momentum=momentum, ema_decay=ema_decay, image_size=image_size,
             num_classes=num_classes, parallelism=parallelism,
-            axis_size=axis_size,
+            axis_size=axis_size, grad_accum_steps=grad_accum_steps,
         )
     finally:
         jax.config.update("jax_platforms", prev_platforms)
@@ -93,7 +94,7 @@ def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
 
 def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
                 topology, n_devices, momentum, ema_decay, image_size,
-                num_classes, parallelism, axis_size):
+                num_classes, parallelism, axis_size, grad_accum_steps=1):
     import jax
 
     import jax.numpy as jnp
@@ -151,16 +152,24 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
             input_shape=(1, image_size, image_size, 3),
         )
     )
-    if parallelism != "dp" and remat:
+    if (remat or grad_accum_steps > 1) and parallelism in ("pp", "sp"):
         raise ValueError(
-            "--remat is only supported with --parallelism dp (the other "
-            "step builders have no remat knob)"
+            "--remat/--grad-accum-steps are not supported with "
+            f"--parallelism {parallelism} (pp schedules microbatches "
+            "itself; sp's ring step owns its memory story)"
         )
     if parallelism == "dp":
-        step = make_train_step(model, tx, mesh, remat=remat)
+        if grad_accum_steps > 1:
+            from tpu_ddp.train.steps import make_grad_accum_train_step
+
+            step = make_grad_accum_train_step(
+                model, tx, mesh, accum_steps=grad_accum_steps, remat=remat)
+        else:
+            step = make_train_step(model, tx, mesh, remat=remat)
     else:
         step, state = _build_sharded(parallelism, model, tx, mesh, state,
-                                     axis_size, image_size)
+                                     axis_size, image_size, remat=remat,
+                                     grad_accum_steps=grad_accum_steps)
 
     # batch scales with the DATA axis only: model/pipeline/expert shards
     # see the same per-data-shard batch (matches aot_v5e.py's programs)
@@ -191,6 +200,7 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
         "n_devices": len(devices),
         "compute_dtype": compute_dtype,
         "remat": remat,
+        "grad_accum_steps": grad_accum_steps,
         "device_kind": kind,
         "per_device": {
             "argument_bytes": arg,
@@ -205,7 +215,7 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
 
 
 def _build_sharded(parallelism, model, tx, mesh, state, axis_size,
-                   image_size):
+                   image_size, *, remat=False, grad_accum_steps=1):
     """(compiled-step builder, abstractified state) for the sharded
     layouts, mirroring the exact step builders benchmarks/aot_v5e.py
     compiles — the planner's fit verdict comes from the same programs the
@@ -224,7 +234,8 @@ def _build_sharded(parallelism, model, tx, mesh, state, axis_size,
         from tpu_ddp.parallel.tensor_parallel import make_fsdp_train_step
 
         step, shardings = make_fsdp_train_step(
-            model, tx, mesh, state, has_batch_stats=has_bs
+            model, tx, mesh, state, has_batch_stats=has_bs,
+            remat=remat, grad_accum_steps=grad_accum_steps,
         )
         return step, abstract_train_state(state, shardings)
 
@@ -243,7 +254,8 @@ def _build_sharded(parallelism, model, tx, mesh, state, axis_size,
         mk = (make_tp_train_step if parallelism == "tp"
               else make_fsdp_tp_train_step)
         step, shardings = mk(model, tx, mesh, state,
-                             rules=rules, has_batch_stats=has_bs)
+                             rules=rules, has_batch_stats=has_bs,
+                             remat=remat, grad_accum_steps=grad_accum_steps)
         return step, abstract_train_state(state, shardings)
 
     if parallelism == "pp":
@@ -283,7 +295,10 @@ def _build_sharded(parallelism, model, tx, mesh, state, axis_size,
                 "--parallelism ep plans the expert-parallel MoE layout; "
                 "pick vit_moe_s4"
             )
-        step, shardings = make_ep_train_step(model, tx, mesh, state)
+        step, shardings = make_ep_train_step(
+            model, tx, mesh, state,
+            remat=remat, grad_accum_steps=grad_accum_steps,
+        )
         return step, abstract_train_state(state, shardings)
 
     if parallelism == "sp":
@@ -314,7 +329,12 @@ def main(argv=None) -> dict:
                    help="per-shard batch")
     p.add_argument("--compute-dtype", choices=["float32", "bfloat16"],
                    default="float32")
-    p.add_argument("--remat", action="store_true")
+    p.add_argument("--remat", action="store_true",
+                   help="plan with rematerialization (composes with "
+                        "dp/fsdp/tp/fsdp_tp/ep)")
+    p.add_argument("--grad-accum-steps", type=int, default=1,
+                   help="plan with gradient accumulation (composes with "
+                        "dp/fsdp/tp/fsdp_tp/ep)")
     p.add_argument("--parallelism", choices=list(PARALLELISMS), default="dp",
                    help="fsdp = ZeRO-3 state scatter (argument_bytes shows "
                         "the 1/N shrink); tp/fsdp_tp/pp/ep/sp plan the "
@@ -344,7 +364,7 @@ def main(argv=None) -> dict:
         momentum=args.momentum, ema_decay=args.ema_decay,
         image_size=args.image_size,
         num_classes=args.num_classes, parallelism=args.parallelism,
-        axis_size=args.axis_size,
+        axis_size=args.axis_size, grad_accum_steps=args.grad_accum_steps,
     )
     print(json.dumps(report, indent=1))
     if report["fits"] is False:

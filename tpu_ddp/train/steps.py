@@ -46,6 +46,21 @@ from tpu_ddp.train.losses import (
 )
 from tpu_ddp.train.state import TrainState
 
+
+def resolve_remat(model, remat: bool):
+    """(possibly-cloned model, need_whole_forward_checkpoint).
+
+    Families with a ``remat`` field (ViT/MoEViT) rematerialize PER BLOCK —
+    the granularity that actually reduces peak HBM (only block-boundary
+    activations are stored; measured in tools/memplan.py). Families
+    without it fall back to one whole-forward ``jax.checkpoint``, which
+    keeps the semantics but barely moves peak (the recompute materializes
+    everything at once) — callers apply that wrap themselves so the
+    closure structure stays local."""
+    if remat and hasattr(model, "remat"):
+        return model.clone(remat=True), False
+    return model, remat
+
 Batch = dict
 
 
@@ -71,6 +86,8 @@ def _make_shard_step(
     model picked from the zoo trains correctly through this generic step,
     not only through ``make_ep_train_step``. Reported ``loss`` stays the
     task loss; the aux term appears as its own metric when present."""
+
+    model, remat = resolve_remat(model, remat)
 
     def apply_model(params, batch_stats, images):
         return model.apply(
@@ -288,6 +305,8 @@ def make_grad_accum_train_step(
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    model, remat = resolve_remat(model, remat)
 
     def apply_model(params, batch_stats, images):
         return model.apply(

@@ -242,9 +242,12 @@ def build_strategy(
     loss_fn: Callable = cross_entropy_loss,
     compute_accuracy: bool = True,
     aux_weight: float = 0.01,
-    n_microbatches: int = 2,
+    n_microbatches: int = 4,
+    pp_schedule: str = "gpipe",
     sp_flash: bool = False,
     initial_state: Optional[TrainState] = None,
+    remat: bool = False,
+    grad_accum_steps: int = 1,
 ) -> Strategy:
     """Build the full strategy for any non-dp mode on a prebuilt mesh. (The
     dp path stays in Trainer: its shard_map step, scan fusion, and
@@ -254,12 +257,24 @@ def build_strategy(
     init (the fine-tune path). PP restacks its plain-layout params into the
     stage-major pipeline layout (``to_pipeline_params``) with fresh
     optimizer state.
+
+    ``remat``/``grad_accum_steps`` compose with the GSPMD family
+    (fsdp/tp/fsdp_tp/ep — round-4 verdict item 4: the memory-bound
+    configs need the memory knobs most); pp/sp raise (their step builders
+    own their own microbatching/remat story).
     """
     from tpu_ddp.parallel.partitioning import shard_train_state
     from tpu_ddp.train.steps import make_eval_step, make_predict_step
 
     data_size = mesh.shape[DATA_AXIS]
     replicated = NamedSharding(mesh, P())
+
+    if (remat or grad_accum_steps > 1) and parallelism in ("pp", "sp"):
+        raise ValueError(
+            "--remat/--grad-accum-steps are not supported with "
+            f"--parallelism {parallelism} (pp schedules microbatches "
+            "itself; sp's ring step owns its memory story)"
+        )
 
     if parallelism == "sp":
         _require_model(model, ("vit",), "sp")
@@ -333,8 +348,21 @@ def build_strategy(
         step, shardings = make_pp_train_step(
             model, tx, mesh, state,
             n_microbatches=n_microbatches, loss_fn=loss_fn,
+            schedule=pp_schedule,
         )
         state = shard_train_state(state, shardings)
+        from tpu_ddp.parallel.pipeline import pp_schedule_stats
+
+        stats = pp_schedule_stats(
+            mesh.shape[PIPELINE_AXIS], n_microbatches, pp_schedule)
+        print(
+            f"pp strategy: schedule={stats['schedule']} "
+            f"stages={mesh.shape[PIPELINE_AXIS]} microbatches="
+            f"{n_microbatches} bubble={stats['bubble_fraction']:.1%} "
+            f"in-flight={stats['in_flight_microbatches']} "
+            f"recompute={stats['recompute']}",
+            flush=True,
+        )
 
         plain_eval = make_eval_step(
             model, mesh, loss_fn=loss_fn, compute_accuracy=compute_accuracy
@@ -370,6 +398,7 @@ def build_strategy(
         step, shardings = make_fsdp_train_step(
             model, tx, mesh, state,
             loss_fn=loss_fn, has_batch_stats=has_bs, aux_weight=aux_weight,
+            remat=remat, grad_accum_steps=grad_accum_steps,
         )
     elif parallelism == "tp":
         from tpu_ddp.parallel.tensor_parallel import make_tp_train_step
@@ -379,6 +408,7 @@ def build_strategy(
         step, shardings = make_tp_train_step(
             model, tx, mesh, state, rules=_tp_rules_for(model, parallelism),
             loss_fn=loss_fn, has_batch_stats=has_bs, aux_weight=aux_weight,
+            remat=remat, grad_accum_steps=grad_accum_steps,
         )
     elif parallelism == "fsdp_tp":
         # Scaling-book 2-D layout: Megatron TP over `model` + ZeRO-3
@@ -391,6 +421,7 @@ def build_strategy(
         step, shardings = make_fsdp_tp_train_step(
             model, tx, mesh, state, rules=_tp_rules_for(model, parallelism),
             loss_fn=loss_fn, has_batch_stats=has_bs, aux_weight=aux_weight,
+            remat=remat, grad_accum_steps=grad_accum_steps,
         )
     elif parallelism == "ep":
         _require_model(model, ("moe",), "ep")
@@ -399,7 +430,8 @@ def build_strategy(
         state = initial_state or create_train_state(model, tx, rng)
         has_bs = False
         step, shardings = make_ep_train_step(
-            model, tx, mesh, state, loss_fn=loss_fn, aux_weight=aux_weight
+            model, tx, mesh, state, loss_fn=loss_fn, aux_weight=aux_weight,
+            remat=remat, grad_accum_steps=grad_accum_steps,
         )
     else:
         raise ValueError(f"unknown parallelism {parallelism!r}")

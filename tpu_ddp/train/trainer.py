@@ -68,7 +68,8 @@ class TrainConfig:
                                           # from mesh (default dp)
     mesh: Optional[dict] = None           # axis sizes, e.g. {"data": 2,
                                           # "model": 4}; None = strategy default
-    n_microbatches: int = 2               # GPipe microbatches (pp only)
+    n_microbatches: int = 4               # pipeline microbatches (pp only)
+    pp_schedule: str = "gpipe"            # "gpipe" | "1f1b" (pp only)
     aux_weight: float = 0.01              # MoE load-balance loss weight
     seed: int = 0
     shuffle: bool = True
@@ -432,12 +433,15 @@ class Trainer:
         config = self.config
         from tpu_ddp.train.strategy import build_strategy
 
+        # Genuinely dp-only knobs: the augmentation pipeline and cross-
+        # replica BN live in the dp shard_map step. The memory knobs
+        # (--remat / --grad-accum-steps) compose with the GSPMD family
+        # via build_strategy (round-4 verdict item 4) and raise there for
+        # pp/sp, which own their own microbatching/remat story.
         for flag, name in (
             (config.augment, "--augment"),
             (config.mixup_alpha > 0, "--mixup-alpha"),
-            (config.remat, "--remat"),
             (config.sync_bn, "--sync-bn"),
-            (config.grad_accum_steps > 1, "--grad-accum-steps"),
         ):
             if flag:
                 raise ValueError(
@@ -472,8 +476,11 @@ class Trainer:
             compute_accuracy=with_acc,
             aux_weight=config.aux_weight,
             n_microbatches=config.n_microbatches,
+            pp_schedule=config.pp_schedule,
             sp_flash=config.sp_flash,
             initial_state=initial,
+            remat=config.remat,
+            grad_accum_steps=config.grad_accum_steps,
         )
         self.state = strategy.state
         self.train_step = strategy.train_step
