@@ -327,8 +327,11 @@ def main() -> None:
                 schedule=schedule,
             )
             dbs = NamedSharding(m2, P("data"))
+            # same global batch (8 = per-shard 4, divisible by both
+            # microbatch counts) for BOTH schedules: the gpipe-vs-1f1b
+            # compile/temp/HLO comparison must be apples-to-apples
             return vstep.trace(
-                _abstract(pp_state, shardings), batch_for(2 * n_micro, dbs)
+                _abstract(pp_state, shardings), batch_for(2 * 4, dbs)
             ).lower().compile()
 
         return compile_pp

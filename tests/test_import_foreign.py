@@ -1,0 +1,152 @@
+"""Foreign (torchvision-layout) pretrained-weights import: the
+reference's pretrained-ImageNet fine-tune entry point
+(ppe_main_ddp.py:17,104-111) without torch in the load path."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_ddp.checkpoint.import_foreign import (
+    export_state_dict,
+    import_state_dict,
+    load_state_dict,
+)
+from tpu_ddp.models.zoo import MODEL_REGISTRY
+
+
+def _resnet18(num_classes=10, cifar_stem=False):
+    return MODEL_REGISTRY["resnet18"](
+        num_classes=num_classes, cifar_stem=cifar_stem)
+
+
+def _init(model, size=32):
+    v = model.init(jax.random.key(0), jnp.zeros((1, size, size, 3)),
+                   train=False)
+    return jax.device_get(v["params"]), jax.device_get(v["batch_stats"])
+
+
+def test_roundtrip_is_bitwise(tmp_path):
+    """export -> import reproduces every param/stat bit-for-bit (verdict
+    item 6's round-trip gate)."""
+    model = _resnet18()
+    params, stats = _init(model)
+    path = export_state_dict(params, stats, model, str(tmp_path / "rn18"))
+    got_p, got_s, report = import_state_dict(path, model)
+    assert not report["unmapped"]
+
+    flat_want = dict(jax.tree_util.tree_leaves_with_path(params))
+    flat_got = dict(jax.tree_util.tree_leaves_with_path(got_p))
+    assert flat_want.keys() == flat_got.keys()
+    for k, w in flat_want.items():
+        np.testing.assert_array_equal(np.asarray(w), flat_got[k], err_msg=str(k))
+    flat_want = dict(jax.tree_util.tree_leaves_with_path(stats))
+    flat_got = dict(jax.tree_util.tree_leaves_with_path(got_s))
+    assert flat_want.keys() == flat_got.keys()
+    for k, w in flat_want.items():
+        np.testing.assert_array_equal(np.asarray(w), flat_got[k], err_msg=str(k))
+
+
+def test_torch_pickle_loads_and_unwraps(tmp_path):
+    """A real torch .pt pickle (with the common {'state_dict': ...} +
+    'module.' DDP wrappers and num_batches_tracked noise) imports into the
+    Flax tree; the noise keys surface in the report, never silently."""
+    torch = pytest.importorskip("torch")
+    model = _resnet18()
+    params, stats = _init(model)
+    npz = export_state_dict(params, stats, model, str(tmp_path / "rn18"))
+    with np.load(npz) as z:
+        sd = {f"module.{k}": torch.from_numpy(z[k]) for k in z.files}
+    sd["module.bn1.num_batches_tracked"] = torch.zeros((), dtype=torch.long)
+    pt = tmp_path / "rn18.pt"
+    torch.save({"state_dict": sd}, pt)
+
+    got_p, got_s, report = import_state_dict(str(pt), model)
+    assert report["unmapped"] == ["bn1.num_batches_tracked"]
+    want = dict(jax.tree_util.tree_leaves_with_path(params))
+    got = dict(jax.tree_util.tree_leaves_with_path(got_p))
+    for k, w in want.items():
+        np.testing.assert_array_equal(np.asarray(w), got[k], err_msg=str(k))
+
+
+def test_conv_and_linear_transposes_match_torch_semantics():
+    """The OIHW->HWIO / (O,I)->(I,O) transposes must be the ones that make
+    torch and flax compute the SAME function — a wrong transpose would
+    survive the round-trip test (it is its own inverse), so pin numerics
+    against real torch layers."""
+    torch = pytest.importorskip("torch")
+    import flax.linen as nn
+
+    from tpu_ddp.checkpoint.import_foreign import _T_CONV, _T_LINEAR, _to_flax
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 8, 8, 3)).astype(np.float32)
+
+    tconv = torch.nn.Conv2d(3, 5, 3, padding=1, bias=False)
+    with torch.no_grad():
+        want = tconv(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    want = want.numpy().transpose(0, 2, 3, 1)  # NCHW -> NHWC
+    kernel = _to_flax(tconv.weight.detach().numpy(), _T_CONV)
+    got = nn.Conv(5, (3, 3), padding=1, use_bias=False).apply(
+        {"params": {"kernel": jnp.asarray(kernel)}}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+    tlin = torch.nn.Linear(7, 4)
+    xv = rng.standard_normal((2, 7)).astype(np.float32)
+    with torch.no_grad():
+        want = tlin(torch.from_numpy(xv)).numpy()
+    got = nn.Dense(4).apply(
+        {"params": {"kernel": jnp.asarray(_to_flax(
+            tlin.weight.detach().numpy(), _T_LINEAR)),
+            "bias": jnp.asarray(tlin.bias.detach().numpy())}},
+        jnp.asarray(xv))
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+
+def test_bottleneck_map_covers_resnet50():
+    """The bottleneck key map (conv1..3 + downsample) covers a full
+    torchvision-layout ResNet-50 dict with nothing unmapped."""
+    model = MODEL_REGISTRY["resnet50"](num_classes=10, cifar_stem=False)
+    params, stats = _init(model)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        path = export_state_dict(params, stats, model, f"{d}/rn50")
+        got_p, _, report = import_state_dict(path, model)
+    assert not report["unmapped"]
+    want = dict(jax.tree_util.tree_leaves_with_path(params))
+    got = dict(jax.tree_util.tree_leaves_with_path(got_p))
+    assert want.keys() == got.keys()
+
+
+def test_head_swap_finetune_e2e(tmp_path):
+    """The reference flow (ppe_main_ddp.py:104-111): ImageNet-layout
+    weights -> new head width -> --pretrained-dir FILE -> one training
+    step. Backbone arrives from the foreign dict, the 1000-class fc is
+    dropped for a fresh 3-class head, and training proceeds."""
+    donor = _resnet18(num_classes=1000)
+    d_params, d_stats = _init(donor, size=32)
+    path = export_state_dict(d_params, d_stats, donor,
+                             str(tmp_path / "imagenet_rn18"))
+
+    from tpu_ddp.train.trainer import TrainConfig, Trainer
+
+    cfg = TrainConfig(
+        synthetic_data=True, synthetic_size=32, per_shard_batch=4,
+        epochs=1, model="resnet18", num_classes=3, pretrained_dir=path,
+    )
+    t = Trainer(cfg)
+    got = dict(jax.tree_util.tree_leaves_with_path(
+        jax.device_get(t.state.params)))
+    want = dict(jax.tree_util.tree_leaves_with_path(d_params))
+    # a deep backbone conv matches the donor bit-for-bit...
+    key = next(k for k in want
+               if "_BasicBlock_7" in str(k) and "Conv_0" in str(k))
+    np.testing.assert_array_equal(np.asarray(want[key]), got[key])
+    # ...the classifier head does NOT (fresh 3-class init)
+    head_key = next(k for k in got if "head" in str(k) and "kernel" in str(k))
+    assert got[head_key].shape[-1] == 3
+    t.run()
+    assert np.isfinite(t.history["train_loss"][-1])
+    t.close()

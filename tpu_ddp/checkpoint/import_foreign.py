@@ -1,0 +1,177 @@
+"""Foreign pretrained-weights import/export for the ResNet family.
+
+The reference fine-tunes from an ImageNet-pretrained torchvision
+checkpoint (``/root/reference/ppe_main_ddp.py:17,104-111`` —
+``models.resnet101(pretrained=True)`` + 1000→3 head swap). Its framework
+gets that for free from torchvision; this framework's equivalent is a
+CONVERTER: a torchvision-layout ``state_dict`` (torch ``.pt``/``.pth``
+pickle, or an ``.npz`` with the same key names) maps onto the Flax
+ResNet tree (``models/resnet_family.py``) by construction —
+
+- ``conv1/bn1``             → ``stem_conv`` / ``stem_bn``
+- ``layer{L}.{b}.conv{c}``  → ``_BasicBlock_{g}/Conv_{c-1}`` (or
+  ``_Bottleneck_{g}/...``), ``g`` the global block index
+- ``layer{L}.{b}.downsample.{0,1}`` → the block's trailing conv/BN pair
+- ``fc``                    → ``head``
+- conv weights OIHW → HWIO, linear weights (O,I) → (I,O), BN
+  ``weight/bias/running_mean/running_var`` → ``scale/bias`` params +
+  ``mean/var`` batch_stats.
+
+``load_pretrained_for_finetune`` routes here whenever
+``--pretrained-dir`` names a FILE instead of an orbax directory; the
+shape-tolerant ``merge_params`` then gives the head swap for free
+(a 1000-class ``fc`` never matches a 3-class ``head``), completing the
+reference's pretrained→fine-tune workflow end to end.
+
+``export_state_dict`` is the exact inverse (same map, transposes
+reversed) — used by the round-trip test and for handing weights back to
+the torch ecosystem.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Tuple
+
+import numpy as np
+
+# foreign-key -> (collection, flax path, transform) transforms
+_T_CONV = "conv"      # OIHW -> HWIO
+_T_LINEAR = "linear"  # (O, I) -> (I, O)
+_T_COPY = "copy"
+
+
+def _resnet_key_map(stage_sizes, bottleneck: bool) -> dict:
+    """torchvision ``state_dict`` key -> (collection, path-in-tree,
+    transform) for a ResNet with the given stage layout."""
+    m: dict = {}
+
+    def conv(tk, path):
+        m[f"{tk}.weight"] = ("params", path + ("kernel",), _T_CONV)
+
+    def bn(tk, path):
+        m[f"{tk}.weight"] = ("params", path + ("scale",), _T_COPY)
+        m[f"{tk}.bias"] = ("params", path + ("bias",), _T_COPY)
+        m[f"{tk}.running_mean"] = ("batch_stats", path + ("mean",), _T_COPY)
+        m[f"{tk}.running_var"] = ("batch_stats", path + ("var",), _T_COPY)
+
+    conv("conv1", ("stem_conv",))
+    bn("bn1", ("stem_bn",))
+    blk_cls = "_Bottleneck" if bottleneck else "_BasicBlock"
+    n_convs = 3 if bottleneck else 2
+    g = 0
+    for stage, n_blocks in enumerate(stage_sizes):
+        for b in range(n_blocks):
+            blk = f"{blk_cls}_{g}"
+            t = f"layer{stage + 1}.{b}"
+            for c in range(n_convs):
+                conv(f"{t}.conv{c + 1}", (blk, f"Conv_{c}"))
+                bn(f"{t}.bn{c + 1}", (blk, f"BatchNorm_{c}"))
+            # projection shortcut: flax trace order puts it AFTER the main
+            # branch, hence the trailing Conv/BN index. Blocks without one
+            # simply have no downsample.* keys in the foreign dict.
+            conv(f"{t}.downsample.0", (blk, f"Conv_{n_convs}"))
+            bn(f"{t}.downsample.1", (blk, f"BatchNorm_{n_convs}"))
+            g += 1
+    m["fc.weight"] = ("params", ("head", "kernel"), _T_LINEAR)
+    m["fc.bias"] = ("params", ("head", "bias"), _T_COPY)
+    return m
+
+
+def _to_flax(arr: np.ndarray, transform: str) -> np.ndarray:
+    if transform == _T_CONV:
+        return np.transpose(arr, (2, 3, 1, 0))
+    if transform == _T_LINEAR:
+        return np.transpose(arr)
+    return arr
+
+
+def _from_flax(arr: np.ndarray, transform: str) -> np.ndarray:
+    if transform == _T_CONV:
+        return np.transpose(arr, (3, 2, 0, 1))
+    if transform == _T_LINEAR:
+        return np.transpose(arr)
+    return arr
+
+
+def _model_map(model) -> dict:
+    from tpu_ddp.models.resnet_family import ResNet, _Bottleneck
+
+    if not isinstance(model, ResNet):
+        raise ValueError(
+            "foreign state_dict import covers the torchvision-layout "
+            "ResNet family (models/resnet_family.py); got "
+            f"{type(model).__name__}. For other families use this "
+            "framework's own orbax checkpoints."
+        )
+    return _resnet_key_map(
+        tuple(model.stage_sizes), model.block is _Bottleneck)
+
+
+def load_state_dict(path: str) -> dict:
+    """Read a foreign checkpoint into {key: np.ndarray}. ``.npz`` loads
+    with numpy alone; anything else goes through ``torch.load`` (CPU,
+    weights_only). Common torch wrappers are unwrapped: a nested
+    ``state_dict``/``model`` entry and DDP's ``module.`` prefix."""
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            raw = {k: z[k] for k in z.files}
+    else:
+        import torch  # CPU build baked into the image
+
+        loaded = torch.load(path, map_location="cpu", weights_only=True)
+        for wrapper in ("state_dict", "model"):
+            if isinstance(loaded, dict) and wrapper in loaded and isinstance(
+                    loaded[wrapper], dict):
+                loaded = loaded[wrapper]
+        raw = {k: v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+               for k, v in loaded.items()}
+    return {k.removeprefix("module."): v for k, v in raw.items()}
+
+
+def import_state_dict(path: str, model) -> Tuple[dict, dict, dict]:
+    """Foreign checkpoint file -> (params, batch_stats, report) nested
+    trees in the Flax layout. ``report`` lists ``unmapped`` foreign keys
+    (e.g. ``num_batches_tracked``, which Flax BN does not carry) so a
+    mis-shaped import is visible instead of silent."""
+    key_map = _model_map(model)
+    sd = load_state_dict(path)
+    out = {"params": {}, "batch_stats": {}}
+    unmapped = []
+    for key, arr in sd.items():
+        entry = key_map.get(key)
+        if entry is None:
+            unmapped.append(key)
+            continue
+        coll, tree_path, transform = entry
+        node = out[coll]
+        for part in tree_path[:-1]:
+            node = node.setdefault(part, {})
+        node[tree_path[-1]] = _to_flax(np.asarray(arr), transform)
+    report = {
+        "mapped": len(sd) - len(unmapped),
+        "unmapped": sorted(unmapped),
+    }
+    return out["params"], out["batch_stats"], report
+
+
+def export_state_dict(params, batch_stats, model, path: str) -> str:
+    """Flax ResNet trees -> torchvision-layout ``.npz`` at ``path`` (the
+    exact inverse of ``import_state_dict``; round-trip pinned by test).
+    npz rather than torch pickle: loadable by torch users via
+    ``{k: torch.from_numpy(v) for ...}`` and by us without torch."""
+    key_map = _model_map(model)
+    trees = {"params": params, "batch_stats": batch_stats}
+    flat = {}
+    for key, (coll, tree_path, transform) in key_map.items():
+        node = trees[coll]
+        try:
+            for part in tree_path:
+                node = node[part]
+        except (KeyError, TypeError):
+            continue  # e.g. a block without a projection shortcut
+        flat[key] = _from_flax(np.asarray(node), transform)
+    if not path.endswith(".npz"):
+        path += ".npz"
+    np.savez(path, **flat)
+    return os.path.abspath(path)

@@ -72,6 +72,19 @@ class Checkpointer:
         step, with the actual delete deferred to the next save_as_only
         (orbax delete is a cross-process collective, so no construction-
         time sweep: a lone process sweeping would hang the barrier)."""
+        # finish any previously-interrupted sweep FIRST: overwriting the
+        # marker while its stale steps remain would lose the old intent,
+        # and a crash before the NEW save lands would then fall back to
+        # the stale max step. Every process runs save_as_only together,
+        # so the collective deletes are safe here.
+        prev = self._marker_step()
+        if prev is not None:
+            for s in self.manager.all_steps():
+                if s != prev:
+                    log.warning(
+                        "completing interrupted save_as_only sweep: "
+                        "deleting stale step %d (keeping %d)", s, prev)
+                    self.manager.delete(s)
         if jax.process_index() == 0:
             marker = os.path.join(self.directory, self._ONLY_MARKER)
             tmp = f"{marker}.tmp.{os.getpid()}"

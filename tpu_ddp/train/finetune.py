@@ -6,17 +6,25 @@ The capability surface of the reference's vestigial script
 (``ppe_main_ddp.py:104-111``), freeze the backbone
 (``ppe_main_ddp.py:116-122`` — broken there by the ``required_grad`` typo;
 working here via optax masking), and train with a second loss (BCE for
-multi-label, ``ppe_main_ddp.py:147``).
+multi-label, ``ppe_main_ddp.py:147``). ``--pretrained-dir`` accepts this
+framework's orbax checkpoints (a directory) AND a foreign
+torchvision-layout state dict (a ``.pt``/``.pth``/``.npz`` FILE) — the
+reference's "start from published ImageNet weights" workflow
+(``ppe_main_ddp.py:17``) via ``checkpoint/import_foreign.py``.
 """
 
 from __future__ import annotations
 
+import logging
+import os
 from typing import Optional
 
 import jax
 
 from tpu_ddp.checkpoint import Checkpointer, merge_params
 from tpu_ddp.train.state import TrainState, create_train_state
+
+log = logging.getLogger(__name__)
 
 
 def load_pretrained_for_finetune(
@@ -35,9 +43,26 @@ def load_pretrained_for_finetune(
     old parameter set); training restarts at step 0 with fresh opt state,
     matching the reference's behavior of constructing a new optimizer for
     fine-tuning (ppe_main_ddp.py:133).
+
+    A FILE path takes the foreign-import route: a torchvision-layout
+    state dict (torch pickle or npz) converted into the Flax tree, then
+    merged exactly like an own-format restore (so the head swap and the
+    stem mismatch of CIFAR-stem models are handled identically).
     """
     rng = rng if rng is not None else jax.random.key(0)
     fresh = create_train_state(model, tx, rng)
+    if os.path.isfile(checkpoint_dir):
+        from tpu_ddp.checkpoint.import_foreign import import_state_dict
+
+        params, batch_stats, report = import_state_dict(
+            checkpoint_dir, model)
+        if report["unmapped"]:
+            log.info("foreign import: %d keys unmapped (e.g. %s)",
+                     len(report["unmapped"]), report["unmapped"][:3])
+        return fresh.replace(
+            params=merge_params(params, fresh.params),
+            batch_stats=merge_params(batch_stats, fresh.batch_stats),
+        )
     ckpt = Checkpointer(checkpoint_dir)
     # Restore into a template shaped like the CHECKPOINT, not the new model:
     # orbax needs matching structure. We restore leniently by reading the
