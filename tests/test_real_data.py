@@ -1,0 +1,119 @@
+"""`make real-data` (tpu_ddp.tools.real_data): the unattended
+download→verify→train→gate pathway, exercised fully offline with a
+stubbed file:// downloader — so the first environment WITH egress runs
+the 93% flow with zero decisions (round-4 verdict item 7)."""
+
+import hashlib
+import json
+import os
+import sys
+
+import pytest
+
+from tests.test_download import _fake_cifar10_tar
+from tpu_ddp.tools.real_data import main
+
+pytestmark = pytest.mark.slow  # end-to-end CLI training runs: make test-all
+
+
+def _served_tar(tmp_path):
+    src = tmp_path / "served" / "cifar-10-python.tar.gz"
+    src.parent.mkdir()
+    _fake_cifar10_tar(src)
+    md5 = hashlib.md5(open(src, "rb").read()).hexdigest()
+    return src.as_uri(), md5
+
+
+def test_real_data_end_to_end_with_stub_downloader(tmp_path, monkeypatch):
+    """Stubbed source: downloads, verifies, extracts, trains the recipe
+    through the real CLI, writes the gate summary, exit 0 when the target
+    is met (target lowered: the fake set has 20 train images)."""
+    monkeypatch.chdir(tmp_path)
+    url, md5 = _served_tar(tmp_path)
+    rc = main([
+        "--data-dir", str(tmp_path / "data"),
+        "--device", "cpu", "--epochs", "1", "--target", "0.0",
+        "--global-batch-size", "8",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+        "--out", str(tmp_path / "summary.json"),
+        "--url", url, "--md5", md5,
+    ])
+    assert rc == 0
+    summary = json.load(open(tmp_path / "summary.json"))
+    assert summary["passed"] and 0.0 <= summary["final_test_accuracy"] <= 1.0
+    # the full artifact trail exists: dataset, checkpoints, metrics
+    assert (tmp_path / "data" / "cifar-10-batches-py" / "data_batch_1").exists()
+    assert (tmp_path / "ck" / "metrics.jsonl").exists()
+
+
+def test_real_data_gate_fails_loud(tmp_path, monkeypatch):
+    """An unreachable target accuracy exits 3 (gate miss), never silently
+    0 — preflight scripts gate on the code."""
+    monkeypatch.chdir(tmp_path)
+    url, md5 = _served_tar(tmp_path)
+    rc = main([
+        "--data-dir", str(tmp_path / "data"),
+        "--device", "cpu", "--epochs", "1", "--target", "1.01",
+        "--global-batch-size", "8",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+        "--out", str(tmp_path / "summary.json"),
+        "--url", url, "--md5", md5,
+    ])
+    assert rc == 3
+    assert not json.load(open(tmp_path / "summary.json"))["passed"]
+
+
+def test_real_data_checksum_failure_is_not_blamed_on_egress(
+        tmp_path, capsys):
+    """Egress worked but the artifact is bad (truncated mirror): the
+    error must describe the checksum problem, not claim 'no egress' —
+    the operator's next move is different."""
+    url, _ = _served_tar(tmp_path)
+    rc = main([
+        "--data-dir", str(tmp_path / "data"),
+        "--device", "cpu",
+        "--url", url, "--md5", "0" * 32,
+        "--out", str(tmp_path / "summary.json"),
+    ])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "checksum" in err and "no network egress" not in err
+
+
+def test_real_data_preemption_is_not_a_gate_miss(tmp_path, monkeypatch,
+                                                 capsys):
+    """A preemption-drained run (trainer returns preempted with NaN
+    accuracy) must exit 4 with a resume hint — never exit 3 claiming the
+    recipe missed the accuracy target."""
+    import tpu_ddp.cli.train as cli_train
+
+    url, md5 = _served_tar(tmp_path)
+    monkeypatch.setattr(
+        cli_train, "main",
+        lambda argv: {"preempted": True, "test_accuracy": float("nan")})
+    rc = main([
+        "--data-dir", str(tmp_path / "data"),
+        "--device", "cpu", "--target", "0.93",
+        "--out", str(tmp_path / "summary.json"),
+        "--url", url, "--md5", md5,
+    ])
+    assert rc == 4
+    err = capsys.readouterr().err
+    assert "preempted" in err and "resume" in err.lower()
+    assert not (tmp_path / "summary.json").exists()
+
+
+def test_real_data_no_egress_message(tmp_path, capsys):
+    """Exactly this build environment's state: the fetch fails -> clear
+    'no network egress' message and exit 2, before any training starts."""
+    rc = main([
+        "--data-dir", str(tmp_path / "data"),
+        "--device", "cpu",
+        "--url", (tmp_path / "missing.tar.gz").as_uri(),
+        "--md5", "0" * 32,
+        "--out", str(tmp_path / "summary.json"),
+    ])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "no network egress" in err
+    assert not os.path.exists(tmp_path / "summary.json")

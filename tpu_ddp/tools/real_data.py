@@ -1,0 +1,140 @@
+"""One-command real-CIFAR-10 pathway: download → verify → train → gate.
+
+``make real-data`` (or ``python -m tpu_ddp.tools.real_data``) runs the
+whole 93% north-star flow unattended the first time an environment with
+network egress gets this repo (BASELINE.md "The 93% pathway"):
+
+1. fetch + MD5-verify + atomically extract the canonical CIFAR-10
+   tarball (``data/download.py`` — torchvision-equivalent semantics);
+2. train the documented 93% recipe through the REAL product CLI
+   (ResNet-18, untied blocks, random-crop+flip, momentum 0.9, cosine
+   decay, weight decay 5e-4, label smoothing, bf16 on TPU);
+3. gate on final test accuracy ≥ ``--target`` (default 0.93): exit 0
+   with a JSON summary on success, exit 3 on a miss.
+
+In THIS build environment (zero egress — verified every round,
+BASELINE.md) step 1 fails fast with an explicit "no network egress"
+message and exit 2: the one decision the next operator needs is made in
+the error text. The flow itself is tested offline with a stubbed
+(file://) downloader in tests/test_real_data.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="download -> verify -> train the 93% CIFAR-10 recipe "
+                    "-> accuracy gate")
+    p.add_argument("--data-dir", default="data/CIFAR-10")
+    p.add_argument("--device", default="tpu", choices=["tpu", "cpu"],
+                   help="tpu (the target; fails loudly without a chip) or "
+                        "cpu (smoke/testing)")
+    p.add_argument("--epochs", type=int, default=100)
+    p.add_argument("--target", type=float, default=0.93,
+                   help="final-test-accuracy gate")
+    p.add_argument("--global-batch-size", type=int, default=512)
+    p.add_argument("--checkpoint-dir", default="ckpt_real_data")
+    p.add_argument("--out", default="real_data_summary.json")
+    p.add_argument("--url", default=None,
+                   help="override the canonical tarball URL (mirrors, "
+                        "offline tests)")
+    p.add_argument("--md5", default=None, help="override with --url")
+    p.add_argument("--extra", nargs=argparse.REMAINDER, default=[],
+                   help="extra flags appended to the training CLI "
+                        "verbatim (after '--extra')")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from tpu_ddp.data.download import ensure_dataset
+
+    try:
+        ensure_dataset(args.data_dir, "cifar10", download=True,
+                       url=args.url, md5=args.md5)
+    except urllib.error.URLError as e:
+        print(
+            f"real-data: could not fetch CIFAR-10 ({e}).\n"
+            "This environment has no network egress (the build "
+            "environment's documented state, BASELINE.md). Re-run "
+            "`make real-data` where egress exists, or pre-place "
+            "cifar-10-python.tar.gz under the data dir and re-run — "
+            "every later step is unattended.",
+            file=sys.stderr,
+        )
+        return 2
+    except (TimeoutError, OSError) as e:
+        # egress worked but the artifact/extraction did not (checksum
+        # mismatch from a bad mirror, disk full, ...): say THAT, not
+        # "no egress" — the operator's next move is different
+        print(
+            f"real-data: CIFAR-10 fetch/prepare failed after download "
+            f"was attempted: {e}\nFix the source (--url/--md5 for a "
+            "mirror) or local disk and re-run.",
+            file=sys.stderr,
+        )
+        return 2
+
+    # The documented 93% recipe (BASELINE.md), through the product CLI.
+    from tpu_ddp.cli.train import main as train_main
+
+    cli = [
+        "--device", args.device,
+        "--data-dir", args.data_dir,
+        "--model", "resnet18", "--untied-blocks",
+        "--augment", "--momentum", "0.9",
+        "--schedule", "cosine", "--weight-decay", "5e-4",
+        "--global-batch-size", str(args.global_batch_size),
+        "--lr", "0.2",
+        "--epochs", str(args.epochs),
+        "--eval-each-epoch", "--label-smoothing", "0.1",
+        "--checkpoint-dir", args.checkpoint_dir, "--keep-best",
+        # --resume: a re-run after preemption/interruption continues from
+        # the saved step instead of restarting (no-op on a fresh dir)
+        "--resume",
+        "--jsonl", f"{args.checkpoint_dir}/metrics.jsonl",
+    ]
+    if args.device == "tpu":
+        cli += ["--compute-dtype", "bfloat16"]
+    cli += list(args.extra)
+    metrics = train_main(cli)
+
+    if metrics.get("preempted"):
+        # drained on a preemption signal: checkpoint written, no final
+        # eval ran — this is NOT a gate miss; re-running resumes
+        print(
+            "real-data: training was preempted; checkpoint saved under "
+            f"{args.checkpoint_dir}. Re-run `make real-data` to resume "
+            "from the saved step.",
+            file=sys.stderr,
+        )
+        return 4
+
+    acc = float(metrics.get("test_accuracy", float("nan")))
+    summary = {
+        "recipe": "resnet18 untied + augment + momentum/cosine/wd "
+                  "(BASELINE.md 93% pathway)",
+        "epochs": args.epochs,
+        "final_test_accuracy": acc,
+        "target": args.target,
+        "passed": bool(acc >= args.target),
+    }
+    with open(args.out, "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+    if not summary["passed"]:
+        print(f"real-data: FINAL ACCURACY {acc:.4f} < target "
+              f"{args.target}", file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
