@@ -349,8 +349,10 @@ def main() -> None:
 
         devs = np.asarray(topo.devices).reshape(2, 4)
         m2 = Mesh(devs, ("data", "expert"))
+        # top-2 GShard routing: the richer dispatch (two choices,
+        # choice-major capacity) is the one worth pinning for v5e
         moe = MoEViT(patch_size=8, hidden_dim=32, depth=2, num_heads=2,
-                     num_experts=4, moe_every=2)
+                     num_experts=4, top_k=2, moe_every=2)
         vtx = make_optimizer(lr=1e-2, momentum=0.9)
         vstate = jax.eval_shape(
             lambda: create_train_state(moe, vtx, jax.random.key(0))

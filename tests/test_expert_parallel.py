@@ -60,6 +60,68 @@ def test_moe_mlp_matches_manual_loop():
     np.testing.assert_allclose(np.asarray(y), expected, rtol=2e-5, atol=2e-5)
 
 
+def test_moe_top2_matches_manual_loop():
+    """GShard top-2: output == sum over the two selected experts of the
+    pair-normalized gate times that expert's MLP, per token (no drops at a
+    generous capacity factor)."""
+    layer = MoEMlp(num_experts=4, top_k=2, capacity_factor=4.0, mlp_ratio=2)
+    x = jax.random.normal(jax.random.key(6), (2, 6, 8), jnp.float32)
+    variables = layer.init(jax.random.key(7), x)
+    y = layer.apply(variables, x)
+    p = variables["params"]
+
+    logits = x @ p["router"]["kernel"] + p["router"]["bias"]
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    expected = np.zeros_like(np.asarray(x))
+    for b in range(x.shape[0]):
+        for t in range(x.shape[1]):
+            top2 = np.argsort(probs[b, t])[::-1][:2]
+            sel = probs[b, t][top2]
+            gates = sel / sel.sum()
+            for g, e in zip(gates, top2):
+                h = np.asarray(x)[b, t] @ np.asarray(p["w_up"])[e] \
+                    + np.asarray(p["b_up"])[e]
+                h = np.asarray(jax.nn.gelu(jnp.asarray(h)))
+                out = h @ np.asarray(p["w_down"])[e] \
+                    + np.asarray(p["b_down"])[e]
+                expected[b, t] += g * out
+    np.testing.assert_allclose(np.asarray(y), expected, rtol=2e-5, atol=2e-5)
+
+
+def test_moe_top2_aux_loss_matches_first_choice_definition():
+    """The load-balance loss at top_k=2 uses the FIRST choice (the Switch
+    definition), so it stays >= ~1 and comparable across k."""
+    for k in (1, 2):
+        layer = MoEMlp(num_experts=4, top_k=k, mlp_ratio=2)
+        x = jax.random.normal(jax.random.key(4), (4, 16, 8), jnp.float32)
+        variables = layer.init(jax.random.key(5), x)
+        _, mutated = layer.apply(
+            {"params": variables["params"]}, x, mutable=["aux_loss"]
+        )
+        (aux,) = mutated["aux_loss"]["load_balance"]
+        assert 1.0 <= float(aux) < 4.0, (k, float(aux))
+
+
+def test_moe_top2_capacity_drop_is_per_choice():
+    """Overflow handling at top_k=2 is DROP, choice-major: first choices
+    claim buffer slots before any second choice, each expert serves at
+    most `capacity` slots total, and a token whose choices both drop
+    outputs exactly zero (the residual carries it)."""
+    E, T = 2, 8
+    # capacity = ceil(T * K * cf / E) = 1 -> one slot per expert total
+    layer = MoEMlp(num_experts=E, top_k=2, capacity_factor=E / (2 * T),
+                   mlp_ratio=2)
+    x = jax.random.normal(jax.random.key(8), (1, T, 8), jnp.float32)
+    variables = layer.init(jax.random.key(9), x)
+    y = np.asarray(layer.apply(variables, x))
+    nonzero_rows = int((np.abs(y[0]).max(axis=-1) > 0).sum())
+    # at most E slots exist in total; with choice-major filling they are
+    # claimed by first-choice tokens, so at most E token rows are nonzero
+    assert nonzero_rows <= E
+    # and at least one row IS dropped to zero at this pressure
+    assert nonzero_rows < T
+
+
 def test_moe_capacity_drops_overflow_tokens():
     """With capacity 1 per expert, at most E tokens per row get nonzero
     output; dropped tokens produce exactly zero (residual carries them)."""

@@ -9,8 +9,9 @@ MXU-shaped, and sharding the stacked expert weights over an ``expert`` mesh
 axis makes the partitioner insert the token all-to-all automatically.
 
 Components:
-- ``MoEMlp``      — top-1 (Switch) routed FFN with capacity + load-balance
-                    aux loss (sown into the ``aux_loss`` collection).
+- ``MoEMlp``      — top-k routed FFN (Switch top-1 default, GShard top-2+)
+                    with a capacity factor + load-balance aux loss (sown
+                    into the ``aux_loss`` collection).
 - ``MoETransformerBlock`` — pre-LN block whose FFN is a ``MoEMlp``.
 - ``MoEViT``      — ViT that interleaves dense and MoE blocks
                     (``moe_every``), same interface as ``models.vit.ViT``.
@@ -32,14 +33,23 @@ from tpu_ddp.models.zoo import register
 
 
 class MoEMlp(nn.Module):
-    """Switch-style top-1 routed FFN over ``num_experts`` experts.
+    """Top-k routed FFN over ``num_experts`` experts (Switch at ``top_k=1``
+    — the default — GShard-style at ``top_k=2``+).
 
     Dispatch is the GShard dense formulation: a one-hot tensor
-    ``(B, T, E, capacity)`` routes each token to a slot in its expert's
-    fixed-size buffer; tokens past capacity are *dropped* (their MLP output
-    is zero — the residual connection in the enclosing block carries them
-    through unchanged, the standard Switch behavior). Router math runs in
-    f32 regardless of compute dtype (bf16 softmax routing is unstable).
+    ``(B, T, E, capacity)`` routes each (token, choice) to a slot in its
+    expert's fixed-size buffer; slots past capacity are *dropped* (that
+    choice's MLP output is zero — the residual connection in the enclosing
+    block carries the token through unchanged, and with ``top_k>1`` a
+    token's surviving choices still contribute). No re-routing: dropped is
+    dropped, the standard Switch/GShard behavior, pinned by test.
+
+    ``capacity_factor`` scales the per-expert buffer against the balanced
+    load: ``capacity = ceil(T * top_k * capacity_factor / num_experts)``.
+    Gate convention: ``top_k=1`` keeps Switch's raw top probability
+    (combine weight < 1); ``top_k>1`` normalizes the selected
+    probabilities to sum to 1 (GShard).  Router math runs in f32
+    regardless of compute dtype (bf16 softmax routing is unstable).
 
     Expert weights are stacked with a leading ``E`` dim — ``w_up (E, C, H)``,
     ``w_down (E, H, C)`` — so expert parallelism is one PartitionSpec:
@@ -47,6 +57,7 @@ class MoEMlp(nn.Module):
     """
 
     num_experts: int
+    top_k: int = 1
     capacity_factor: float = 1.25
     mlp_ratio: int = 4
     dtype: jnp.dtype = jnp.float32
@@ -55,22 +66,29 @@ class MoEMlp(nn.Module):
     def __call__(self, x):  # (B, T, C) -> (B, T, C)
         B, T, C = x.shape
         E = self.num_experts
+        K = self.top_k
         H = C * self.mlp_ratio
-        capacity = max(1, int(np.ceil(T * self.capacity_factor / E)))
+        capacity = max(1, int(np.ceil(T * K * self.capacity_factor / E)))
 
         # --- routing (f32) ---
         logits = nn.Dense(E, dtype=jnp.float32, name="router")(
             x.astype(jnp.float32)
         )  # (B, T, E)
         probs = jax.nn.softmax(logits, axis=-1)
-        gate = jnp.max(probs, axis=-1)                       # (B, T)
-        expert_idx = jnp.argmax(probs, axis=-1)              # (B, T)
-        mask = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # (B, T, E)
+        topk_p, topk_i = jax.lax.top_k(probs, K)             # (B, T, K)
+        if K == 1:
+            gates = topk_p                                   # Switch: raw p1
+        else:
+            gates = topk_p / jnp.maximum(                    # GShard: renorm
+                topk_p.sum(axis=-1, keepdims=True), 1e-9)
 
-        # Switch load-balance loss: E * sum_e fraction_e * mean_prob_e;
-        # equals 1.0 at perfect balance. Sown; the EP train step adds it
-        # to the task loss with a small weight.
-        frac = mask.mean(axis=1)                             # (B, E)
+        # Switch load-balance loss over the FIRST choice (the paper's
+        # definition; identical to the top-1 formula at K=1):
+        # E * sum_e fraction_e * mean_prob_e == 1.0 at perfect balance.
+        # Sown; the EP train step adds it to the task loss with a small
+        # weight.
+        mask0 = jax.nn.one_hot(topk_i[..., 0], E, dtype=jnp.float32)
+        frac = mask0.mean(axis=1)                            # (B, E)
         mean_prob = probs.mean(axis=1)                       # (B, E)
         self.sow(
             "aux_loss",
@@ -79,14 +97,25 @@ class MoEMlp(nn.Module):
         )
 
         # --- capacity + dispatch/combine tensors ---
-        # position of each token in its expert's queue; -1 where this
-        # (token, expert) pair is unrouted. one_hot maps both -1 and
-        # >= capacity to the zero row, which implements dropping for free.
-        pos = jnp.cumsum(mask, axis=1) * mask - 1.0          # (B, T, E)
-        dispatch = jax.nn.one_hot(
-            pos.astype(jnp.int32), capacity, dtype=jnp.float32
-        )                                                    # (B, T, E, Cap)
-        combine = dispatch * gate[:, :, None, None]          # (B, T, E, Cap)
+        # choice-major slot assignment (GShard): all first choices claim
+        # buffer positions before any second choice, so under pressure the
+        # primary routes survive. Position is -1 where a (token, expert)
+        # pair is unrouted; one_hot maps both -1 and >= capacity to the
+        # zero row, which implements dropping for free.
+        dispatch = jnp.zeros((B, T, E, capacity), jnp.float32)
+        combine = jnp.zeros((B, T, E, capacity), jnp.float32)
+        count = jnp.zeros((B, 1, E), jnp.float32)  # slots claimed so far
+        for j in range(K):
+            mask_j = jax.nn.one_hot(topk_i[..., j], E, dtype=jnp.float32)
+            pos_j = jnp.where(
+                mask_j > 0, jnp.cumsum(mask_j, axis=1) - 1.0 + count, -1.0
+            )                                                # (B, T, E)
+            disp_j = jax.nn.one_hot(
+                pos_j.astype(jnp.int32), capacity, dtype=jnp.float32
+            )                                                # (B, T, E, Cap)
+            dispatch = dispatch + disp_j
+            combine = combine + disp_j * gates[:, :, j, None, None]
+            count = count + mask_j.sum(axis=1, keepdims=True)
 
         # --- expert computation (stacked, leading E dim) ---
         xd = jnp.einsum(
@@ -123,6 +152,7 @@ class MoETransformerBlock(nn.Module):
 
     num_heads: int
     num_experts: int
+    top_k: int = 1
     capacity_factor: float = 1.25
     mlp_ratio: int = 4
     dtype: jnp.dtype = jnp.float32
@@ -137,6 +167,7 @@ class MoETransformerBlock(nn.Module):
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
         x = x + MoEMlp(
             self.num_experts,
+            top_k=self.top_k,
             capacity_factor=self.capacity_factor,
             mlp_ratio=self.mlp_ratio,
             dtype=self.dtype,
@@ -155,6 +186,7 @@ class MoEViT(nn.Module):
     num_heads: int = 3
     num_classes: int = 10
     num_experts: int = 8
+    top_k: int = 1
     moe_every: int = 2
     capacity_factor: float = 1.25
     mlp_ratio: int = 4
@@ -190,6 +222,7 @@ class MoEViT(nn.Module):
                 x = moe_cls(
                     self.num_heads,
                     num_experts=self.num_experts,
+                    top_k=self.top_k,
                     capacity_factor=self.capacity_factor,
                     mlp_ratio=self.mlp_ratio,
                     dtype=self.dtype,
@@ -214,3 +247,12 @@ def vit_moe_s4(num_classes: int = 10, bn_cross_replica_axis=None,
     """Small MoE ViT for 32x32 inputs: 8 experts, MoE every other block."""
     return MoEViT(patch_size=4, hidden_dim=192, depth=6, num_heads=3,
                   num_classes=num_classes, num_experts=8, dtype=dtype)
+
+
+@register("vit_moe_s4_top2")
+def vit_moe_s4_top2(num_classes: int = 10, bn_cross_replica_axis=None,
+                    dtype=jnp.float32):
+    """vit_moe_s4 with GShard top-2 routing (normalized pair gates)."""
+    return MoEViT(patch_size=4, hidden_dim=192, depth=6, num_heads=3,
+                  num_classes=num_classes, num_experts=8, top_k=2,
+                  dtype=dtype)

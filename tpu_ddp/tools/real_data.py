@@ -59,6 +59,16 @@ def main(argv=None) -> int:
     try:
         ensure_dataset(args.data_dir, "cifar10", download=True,
                        url=args.url, md5=args.md5)
+    except urllib.error.HTTPError as e:
+        # a RESPONDING server (404/403/500) is not an egress problem —
+        # route it with the other source-side failures below
+        print(
+            f"real-data: CIFAR-10 fetch/prepare failed after download "
+            f"was attempted: {e}\nFix the source (--url/--md5 for a "
+            "mirror) or local disk and re-run.",
+            file=sys.stderr,
+        )
+        return 2
     except urllib.error.URLError as e:
         print(
             f"real-data: could not fetch CIFAR-10 ({e}).\n"
