@@ -240,6 +240,63 @@ def main() -> None:
 
     jobs.append(("compute_wrn28_10_b128", wrn))
 
+    # Round-5 capture legs (one program each):
+    # attention_causal — causal flash at the attention_op shape
+    def attention_causal():
+        from tpu_ddp.ops.flash_attention import flash_attention
+
+        B, T, H, D = 4, 2048, 8, 128
+        sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+        qs = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16, sharding=sh)
+        loss = jax.jit(jax.value_and_grad(
+            lambda a, b, c: flash_attention(
+                a, b, c, 128, 128, False, causal=True
+            ).astype(jnp.float32).mean(),
+            (0, 1, 2),
+        ))
+        return loss.trace(qs, qs, qs)
+
+    jobs.append(("attention_causal_T2048", attention_causal))
+
+    # longseq_full / longseq_flash — T=8192 ring-tile points
+    def longseq(impl_name):
+        def go():
+            from tpu_ddp.ops.flash_attention import (
+                _reference,
+                flash_attention,
+            )
+
+            fn = (_reference if impl_name == "full"
+                  else lambda a, b, c: flash_attention(a, b, c, 128, 128,
+                                                       False))
+            B, T, H, D = 1, 8192, 8, 128
+            sh = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec())
+            qs = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16,
+                                      sharding=sh)
+            loss = jax.jit(jax.value_and_grad(
+                lambda a, b, c: fn(a, b, c).astype(jnp.float32).mean(),
+                (0, 1, 2),
+            ))
+            return loss.trace(qs, qs, qs)
+        return go
+
+    jobs.append(("longseq_full_T8192", longseq("full")))
+    jobs.append(("longseq_flash_T8192", longseq("flash")))
+
+    # dense_step / moe_step — vit_s4 vs vit_moe_s4 train steps, bf16 b128
+    def vit_step(model_name):
+        def go():
+            model = MODEL_REGISTRY[model_name](num_classes=10,
+                                               dtype=jnp.bfloat16)
+            tx = make_optimizer(lr=1e-2, momentum=0.9)
+            step = make_train_step(model, tx, mesh)
+            return step.trace(astate(model, tx), flat_batch(128))
+        return go
+
+    jobs.append(("dense_step_vit_s4_b128", vit_step("vit_s4")))
+    jobs.append(("moe_step_vit_moe_s4_b128", vit_step("vit_moe_s4")))
+
     before = set(os.listdir(CACHE_DIR)) if os.path.isdir(CACHE_DIR) else set()
     for name, job in jobs:
         t0 = time.time()
