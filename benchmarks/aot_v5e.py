@@ -435,6 +435,41 @@ def main() -> None:
         "ring_attention_16k_x8", long_ctx_compile
     )
 
+    # 8b'. CAUSAL LM at long context: the full decoder MODEL (embed +
+    # causal flash-ring blocks + vocab head + next-token loss + optimizer
+    # update), 32,768 tokens ring-sharded 8 ways, bf16, complete
+    # SP train step — the round-5 decoder family actually training at a
+    # length where full attention would materialize 4 GiB of scores per
+    # head-batch.
+    def lm_long_ctx_compile():
+        from tpu_ddp.models.lm import CausalTransformerLM
+        from tpu_ddp.train.lm_steps import (
+            create_lm_train_state,
+            make_sp_lm_train_step,
+        )
+
+        m1 = Mesh(np.asarray(topo.devices).reshape(1, 8),
+                  ("data", "sequence"))
+        T = 32768
+        lm = CausalTransformerLM(
+            vocab_size=32000, hidden_dim=512, depth=4, num_heads=8,
+            sp_axis="sequence", sp_flash=True, attention_interpret=False,
+            dtype=jnp.bfloat16,
+        )
+        ltx = make_optimizer(lr=1e-3)
+        lstate = jax.eval_shape(
+            lambda: create_lm_train_state(lm, ltx, jax.random.key(0),
+                                          seq_len=T)
+        )
+        step = make_sp_lm_train_step(lm, ltx, m1)
+        batch = {"tokens": jax.ShapeDtypeStruct(
+            (1, T), jnp.int32,
+            sharding=NamedSharding(m1, P("data", "sequence")))}
+        return step.trace(_abstract(lstate), batch).lower().compile()
+
+    progs["lm_causal_32k_sp_x8"] = _compile(
+        "lm_causal_32k_sp_x8", lm_long_ctx_compile)
+
     # 8c. POD-SCALE long context: 131,072 tokens ring-sharded 64 ways
     # (2,048/device) x 4-way data parallel on the full v5e-256 pod, bf16,
     # forward AND backward wrt q/k/v. Above _UNROLL_MAX the ring rolls

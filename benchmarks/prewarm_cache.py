@@ -146,24 +146,29 @@ def main() -> None:
     jobs.append(("attention_full_vit_bf16_b128", attention("full")))
     jobs.append(("attention_flash_vit_bf16_b128", attention("flash")))
 
-    # bench._attention_op_microbench: raw-op fwd+bwd at T=2048, both impls
-    def attention_op(impl_name):
+    # Attention-op fwd+bwd trace points (bench._time_attn_impl's program
+    # shape), shared by the T=2048 microbench pair, the causal row, and
+    # the T=8192 longseq pair — ONE recipe so a timing-discipline change
+    # in bench.py has a single prewarm mirror to update.
+    def attention_point(impl_name, B, T, causal=False):
         def go():
             from tpu_ddp.ops.flash_attention import (
                 _reference,
                 flash_attention,
             )
 
-            fn = (_reference if impl_name == "full"
-                  else lambda a, b, c: flash_attention(a, b, c, 128, 128,
-                                                       False))
+            if impl_name == "full":
+                fn = (lambda a, b, c: _reference(a, b, c, causal=causal))
+            else:
+                fn = (lambda a, b, c: flash_attention(
+                    a, b, c, 128, 128, False, causal=causal))
             # The topology sharding is REQUIRED here even though the live
             # microbench jits plain unsharded arrays: without it the
             # deviceless trace targets the CPU backend, where the
             # non-interpret Pallas kernel refuses to compile at all. The
             # key-fidelity cost is the tool's documented caveat — an
             # unshared-key miss just means a normal compile on-chip.
-            B, T, H, D = 4, 2048, 8, 128
+            H, D = 8, 128
             sh = jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec()
             )
@@ -176,8 +181,9 @@ def main() -> None:
             return loss.trace(qs, qs, qs)
         return go
 
-    jobs.append(("attention_op_full_T2048", attention_op("full")))
-    jobs.append(("attention_op_flash_T2048", attention_op("flash")))
+    jobs.append(("attention_op_full_T2048", attention_point("full", 4, 2048)))
+    jobs.append(("attention_op_flash_T2048",
+                 attention_point("flash", 4, 2048)))
 
     # capture_tpu sweep points: scan K x per-shard batch
     for k in (32, 128):
@@ -240,49 +246,12 @@ def main() -> None:
 
     jobs.append(("compute_wrn28_10_b128", wrn))
 
-    # Round-5 capture legs (one program each):
-    # attention_causal — causal flash at the attention_op shape
-    def attention_causal():
-        from tpu_ddp.ops.flash_attention import flash_attention
-
-        B, T, H, D = 4, 2048, 8, 128
-        sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
-        qs = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16, sharding=sh)
-        loss = jax.jit(jax.value_and_grad(
-            lambda a, b, c: flash_attention(
-                a, b, c, 128, 128, False, causal=True
-            ).astype(jnp.float32).mean(),
-            (0, 1, 2),
-        ))
-        return loss.trace(qs, qs, qs)
-
-    jobs.append(("attention_causal_T2048", attention_causal))
-
-    # longseq_full / longseq_flash — T=8192 ring-tile points
-    def longseq(impl_name):
-        def go():
-            from tpu_ddp.ops.flash_attention import (
-                _reference,
-                flash_attention,
-            )
-
-            fn = (_reference if impl_name == "full"
-                  else lambda a, b, c: flash_attention(a, b, c, 128, 128,
-                                                       False))
-            B, T, H, D = 1, 8192, 8, 128
-            sh = jax.sharding.NamedSharding(
-                mesh, jax.sharding.PartitionSpec())
-            qs = jax.ShapeDtypeStruct((B, T, H, D), jnp.bfloat16,
-                                      sharding=sh)
-            loss = jax.jit(jax.value_and_grad(
-                lambda a, b, c: fn(a, b, c).astype(jnp.float32).mean(),
-                (0, 1, 2),
-            ))
-            return loss.trace(qs, qs, qs)
-        return go
-
-    jobs.append(("longseq_full_T8192", longseq("full")))
-    jobs.append(("longseq_flash_T8192", longseq("flash")))
+    # Round-5 capture legs (one program each): causal flash at the
+    # attention_op shape, and the T=8192 ring-tile points
+    jobs.append(("attention_causal_T2048",
+                 attention_point("flash", 4, 2048, causal=True)))
+    jobs.append(("longseq_full_T8192", attention_point("full", 1, 8192)))
+    jobs.append(("longseq_flash_T8192", attention_point("flash", 1, 8192)))
 
     # dense_step / moe_step — vit_s4 vs vit_moe_s4 train steps, bf16 b128
     def vit_step(model_name):

@@ -1,0 +1,134 @@
+"""Causal transformer LM: the decoder family over the causal flash/ring
+kernels. Pins causality itself, kernel-vs-reference parity inside the
+model, learning on a deterministic task, and SP == DP exactness with the
+cross-shard next-token shift."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tpu_ddp.models.lm import CausalTransformerLM
+from tpu_ddp.parallel import MeshSpec, create_mesh
+from tpu_ddp.train import make_optimizer
+from tpu_ddp.train.lm_steps import (
+    create_lm_train_state,
+    make_lm_train_step,
+    make_sp_lm_train_step,
+)
+
+
+def _tiny(**kw):
+    cfg = dict(vocab_size=17, hidden_dim=32, depth=2, num_heads=2)
+    cfg.update(kw)
+    return CausalTransformerLM(**cfg)
+
+
+def _tokens(B, T, seed=0, vocab=17):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab, (B, T)).astype(np.int32)
+
+
+def test_lm_is_actually_causal():
+    """Changing a FUTURE token must not change any earlier position's
+    logits — the property that makes it a decoder."""
+    model = _tiny()
+    toks = jnp.asarray(_tokens(2, 16))
+    variables = model.init(jax.random.key(0), toks, train=False)
+    base = model.apply(variables, toks, train=False)
+    poked = toks.at[:, 10].set((toks[:, 10] + 1) % 17)
+    out = model.apply(variables, poked, train=False)
+    np.testing.assert_array_equal(np.asarray(base[:, :10]),
+                                  np.asarray(out[:, :10]))
+    assert np.abs(np.asarray(base[:, 10:]) - np.asarray(out[:, 10:])).max() > 0
+
+
+def test_lm_flash_matches_reference_attention():
+    """use_flash=True (Pallas causal kernel, interpret off-TPU) produces
+    the same logits as the fused-jnp causal reference."""
+    toks = jnp.asarray(_tokens(2, 128))
+    ref_model = _tiny()
+    variables = ref_model.init(jax.random.key(1), toks, train=False)
+    ref = ref_model.apply(variables, toks, train=False)
+    flash = _tiny(use_flash=True).apply(variables, toks, train=False)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(ref),
+                               atol=2e-5, rtol=0)
+
+
+def test_lm_learns_deterministic_next_token(devices):
+    """Next-token = fixed permutation of the current token: a causal LM
+    must drive the loss to ~0 quickly; an acausal or shifted-target bug
+    cannot (the task is pure next-token structure)."""
+    vocab = 17
+    perm = np.random.default_rng(3).permutation(vocab)
+    B, T = 8, 32
+    start = np.random.default_rng(4).integers(0, vocab, B)
+    seq = np.zeros((B, T), np.int32)
+    seq[:, 0] = start
+    for t in range(1, T):
+        seq[:, t] = perm[seq[:, t - 1]]
+
+    mesh = create_mesh(MeshSpec(data=-1))
+    model = _tiny(vocab_size=vocab)
+    tx = make_optimizer(lr=0.01, optimizer="adamw")
+    state = create_lm_train_state(model, tx, jax.random.key(0),
+                                  seq_len=T)
+    step = make_lm_train_step(model, tx, mesh)
+    batch = {"tokens": seq}
+    losses = []
+    for _ in range(60):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[0] > 2.0          # ~log(17) at init
+    assert losses[-1] < 0.2, losses[-5:]
+
+
+def test_sp_lm_loss_and_step_match_dp(devices):
+    """Sequence-parallel LM (causal ring attention + cross-shard target
+    shift + last-position mask) reproduces the DP step exactly on a
+    4x2 data x sequence mesh: same loss, same updated params."""
+    B, T = 8, 64
+    toks = _tokens(B, T, seed=7)
+    model_dp = _tiny()
+    tx = optax.sgd(0.5)  # big lr: any mismatch shows in one step
+
+    dp_mesh = create_mesh(MeshSpec(data=-1))
+    state = create_lm_train_state(model_dp, tx, jax.random.key(0),
+                                  seq_len=T)
+    dp_step = make_lm_train_step(model_dp, tx, dp_mesh, donate=False)
+    dp_state, dp_metrics = dp_step(state, {"tokens": toks})
+
+    sp_mesh = create_mesh(MeshSpec(data=4, sequence=2))
+    model_sp = _tiny(sp_axis="sequence")
+    sp_state0 = create_lm_train_state(model_sp, tx, jax.random.key(0),
+                                      seq_len=T)
+    sp_step = make_sp_lm_train_step(model_sp, tx, sp_mesh, donate=False)
+    sp_state, sp_metrics = sp_step(sp_state0, {"tokens": toks})
+
+    assert abs(float(dp_metrics["loss"]) - float(sp_metrics["loss"])) < 1e-5
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_leaves_with_path(jax.device_get(dp_state.params)),
+        jax.tree_util.tree_leaves_with_path(jax.device_get(sp_state.params)),
+    ):
+        assert pa == pb
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=0,
+                                   err_msg=jax.tree_util.keystr(pa))
+
+
+def test_sp_flash_lm_matches_plain_sp(devices):
+    """sp_flash=True (Pallas causal flash ring tiles) agrees with the
+    jnp causal ring on the same params/batch."""
+    B, T = 4, 64
+    toks = _tokens(B, T, seed=9)
+    tx = optax.sgd(0.1)
+    mesh = create_mesh(MeshSpec(data=4, sequence=2))
+    losses = {}
+    for flash in (False, True):
+        model = _tiny(sp_axis="sequence", sp_flash=flash)
+        state = create_lm_train_state(model, tx, jax.random.key(0),
+                                      seq_len=T)
+        step = make_sp_lm_train_step(model, tx, mesh, donate=False)
+        _, metrics = step(state, {"tokens": toks})
+        losses[flash] = float(metrics["loss"])
+    assert np.isfinite(losses[True])
+    np.testing.assert_allclose(losses[True], losses[False], atol=1e-5)

@@ -1,0 +1,146 @@
+"""Next-token training steps for the causal LM family.
+
+Two layouts over the same math (the DP/SP pair mirrors the image steps
+in ``train/steps.py`` / ``parallel/sequence_parallel.py``):
+
+- ``make_lm_train_step`` — data parallel: tokens (B, T) batch-sharded,
+  loss = mean CE of logits[:, :-1] vs tokens[:, 1:], pmean'd before
+  differentiation so AD produces the DDP-averaged gradient.
+- ``make_sp_lm_train_step`` — data x sequence parallel: tokens sharded
+  over BOTH axes; the model runs causal ring attention over the sequence
+  axis, and the next-token targets for each shard's LAST position live
+  on the NEXT shard — one ``ppermute`` of the neighbors' first tokens
+  closes the shift, and the global final position (which has no target)
+  is masked on the last shard. Loss equals the DP step's exactly
+  (pinned by tests/test_lm.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_ddp.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
+from tpu_ddp.train.state import TrainState
+
+
+def _token_nll(logits, targets):
+    """Per-position negative log-likelihood, f32: (B, T', V), (B, T')."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
+
+
+def make_lm_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+    donate: bool = True,
+) -> Callable:
+    """step(state, {"tokens": (B, T) int32}) -> (state, {"loss"})."""
+
+    def shard_step(state: TrainState, batch):
+        tokens = batch["tokens"]
+
+        def compute_loss(params):
+            logits = model.apply({"params": params}, tokens, train=True)
+            loss = _token_nll(logits[:, :-1], tokens[:, 1:]).mean()
+            # pmean BEFORE differentiation: AD of the averaged loss emits
+            # the cross-shard grad psum (the DDP semantics, exactly as in
+            # train/steps.py)
+            return lax.pmean(loss, data_axis)
+
+        loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            state.replace(step=state.step + 1, params=new_params,
+                          opt_state=new_opt),
+            {"loss": loss},
+        )
+
+    sharded = jax.shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(P(), {"tokens": P(data_axis)}),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def make_sp_lm_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    data_axis: str = DATA_AXIS,
+    seq_axis: str = SEQUENCE_AXIS,
+    donate: bool = True,
+) -> Callable:
+    """Sequence-parallel next-token step. ``model`` must be built with
+    ``sp_axis=seq_axis``; tokens arrive (B_local, T_local) per shard."""
+    n_seq = mesh.shape[seq_axis]
+    shift_perm = [(i, (i - 1) % n_seq) for i in range(n_seq)]
+
+    def shard_step(state: TrainState, batch):
+        tokens = batch["tokens"]  # (B_local, T_local)
+
+        def compute_loss(params):
+            logits = model.apply({"params": params}, tokens, train=True)
+            # targets: global left-shift — within the shard it's
+            # tokens[:, 1:], and the LAST local position's target is the
+            # NEXT shard's first token (one neighbor ppermute)
+            next_first = lax.ppermute(tokens[:, :1], seq_axis, shift_perm)
+            targets = jnp.concatenate([tokens[:, 1:], next_first], axis=1)
+            nll = _token_nll(logits, targets)        # (B, T_local)
+            # the global FINAL position has no target: mask it on the
+            # last shard (its ppermute'd "next token" wrapped around)
+            is_last = lax.axis_index(seq_axis) == n_seq - 1
+            tail = jnp.where(is_last, 0.0, 1.0)
+            mask = jnp.concatenate(
+                [jnp.ones(nll.shape[:1] + (nll.shape[1] - 1,), jnp.float32),
+                 jnp.full(nll.shape[:1] + (1,), 1.0) * tail], axis=1)
+            loss_sum = lax.psum((nll * mask).sum(), seq_axis)
+            count = lax.psum(mask.sum(), seq_axis)
+            # global mean over valid positions == the DP step's mean over
+            # (B, T-1); then DDP-average over data
+            return lax.pmean(loss_sum / count, data_axis)
+
+        loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            state.replace(step=state.step + 1, params=new_params,
+                          opt_state=new_opt),
+            {"loss": loss},
+        )
+
+    sharded = jax.shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(P(), {"tokens": P(data_axis, seq_axis)}),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+def create_lm_train_state(model, tx, rng, *, batch: int = 1,
+                          seq_len: int = 16) -> TrainState:
+    """Init an LM TrainState from a dummy token batch. For SP models the
+    init must run through a PLAIN twin (``sp_axis=None``) — param shapes
+    are identical by construction (full global pos table either way)."""
+    init_model = model
+    if getattr(model, "sp_axis", None) is not None:
+        init_model = model.clone(sp_axis=None)
+    variables = init_model.init(
+        rng, jnp.zeros((batch, seq_len), jnp.int32), train=False)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=variables["params"],
+        batch_stats={},
+        opt_state=tx.init(variables["params"]),
+    )
