@@ -109,6 +109,7 @@ def test_sp_lm_loss_and_step_match_dp(devices):
     for (pa, a), (pb, b) in zip(
         jax.tree_util.tree_leaves_with_path(jax.device_get(dp_state.params)),
         jax.tree_util.tree_leaves_with_path(jax.device_get(sp_state.params)),
+        strict=True,
     ):
         assert pa == pb
         np.testing.assert_allclose(a, b, atol=5e-5, rtol=0,

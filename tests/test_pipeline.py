@@ -131,6 +131,7 @@ def test_1f1b_matches_gpipe_exactly(devices):
     for (pa, a), (pb, b) in zip(
         jax.tree_util.tree_leaves_with_path(out["gpipe"][1]),
         jax.tree_util.tree_leaves_with_path(out["1f1b"][1]),
+        strict=True,
     ):
         assert pa == pb
         np.testing.assert_allclose(a, b, atol=1e-5, rtol=0,
@@ -171,6 +172,7 @@ def test_1f1b_matches_plain_vit_grads(devices):
     for (pa, g), (pb, r) in zip(
         jax.tree_util.tree_leaves_with_path(grads),
         jax.tree_util.tree_leaves_with_path(ref),
+        strict=True,
     ):
         assert pa == pb
         np.testing.assert_allclose(g, r, atol=2e-5, rtol=0,

@@ -258,6 +258,7 @@ def test_fsdp_remat_matches_unsharded_math(devices):
     for (pa, a), (pb, b) in zip(
         jax.tree_util.tree_flatten_with_path(base_params)[0],
         jax.tree_util.tree_flatten_with_path(remat_params)[0],
+        strict=True,
     ):
         assert pa == pb
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
@@ -276,6 +277,7 @@ def test_tp_grad_accum_matches_full_batch(devices):
     for (pa, a), (pb, b) in zip(
         jax.tree_util.tree_flatten_with_path(base_params)[0],
         jax.tree_util.tree_flatten_with_path(acc_params)[0],
+        strict=True,
     ):
         assert pa == pb
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),

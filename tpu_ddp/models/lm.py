@@ -28,6 +28,7 @@ Next-token training lives in ``tpu_ddp.train.lm_steps``.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import flax.linen as nn
@@ -80,8 +81,6 @@ class CausalTransformerLM(nn.Module):
                      dtype=self.dtype, name="tok_embed")(tokens)
 
         if self.sp_axis is not None:
-            import functools
-
             from tpu_ddp.parallel.ring_attention import (
                 ring_attention,
                 ring_flash_attention,
@@ -109,8 +108,6 @@ class CausalTransformerLM(nn.Module):
                 (1, T, self.hidden_dim),
             )
             if self.use_flash:
-                import functools
-
                 attention_impl = functools.partial(
                     causal_flash_attention,
                     interpret=self.attention_interpret)

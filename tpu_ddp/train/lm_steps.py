@@ -102,9 +102,7 @@ def make_sp_lm_train_step(
             # last shard (its ppermute'd "next token" wrapped around)
             is_last = lax.axis_index(seq_axis) == n_seq - 1
             tail = jnp.where(is_last, 0.0, 1.0)
-            mask = jnp.concatenate(
-                [jnp.ones(nll.shape[:1] + (nll.shape[1] - 1,), jnp.float32),
-                 jnp.full(nll.shape[:1] + (1,), 1.0) * tail], axis=1)
+            mask = jnp.ones_like(nll).at[:, -1].set(tail)
             loss_sum = lax.psum((nll * mask).sum(), seq_axis)
             count = lax.psum(mask.sum(), seq_axis)
             # global mean over valid positions == the DP step's mean over
