@@ -82,6 +82,17 @@ def test_lm_learns_deterministic_next_token(devices):
     assert losses[0] > 2.0          # ~log(17) at init
     assert losses[-1] < 0.2, losses[-5:]
 
+    # close the decoder loop: greedy generation from an 8-token prompt
+    # must reproduce the permutation rollout exactly
+    from tpu_ddp.models.lm import greedy_generate
+
+    params = jax.device_get(state.params)
+    prompt = seq[:4, :8]
+    out = np.asarray(jax.jit(
+        lambda p, x: greedy_generate(model, p, x, T - 8)
+    )(params, jnp.asarray(prompt)))
+    np.testing.assert_array_equal(out[:, 8:], seq[:4, 8:])
+
 
 def test_sp_lm_loss_and_step_match_dp(devices):
     """Sequence-parallel LM (causal ring attention + cross-shard target

@@ -128,3 +128,34 @@ class CausalTransformerLM(nn.Module):
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
         x = nn.Dense(self.vocab_size, dtype=self.dtype, name="head")(x)
         return x.astype(jnp.float32)
+
+
+def greedy_generate(model, params, prompt, n_new: int):
+    """Greedy decode: (B, T0) int32 prompt -> (B, T0+n_new) continuation.
+
+    XLA-friendly by construction: ONE fixed-size (B, T0+n_new) buffer,
+    one compiled forward reused every step inside ``lax.scan`` — no
+    data-dependent shapes. Causality makes the not-yet-written tail
+    inert (position i-1's logits attend only to <= i-1), so the full
+    re-forward per step is exact without a KV cache; per-step cost is
+    O(T^2) attention, the simple-and-correct trade for a utility decoder
+    (a KV-cache decode path is a perf feature, not a correctness one).
+
+    Constraint: ``T0 + n_new`` must equal the sequence length ``params``
+    was built for (the learned position table's length). The model must
+    be a plain (non-SP) module.
+    """
+    B, T0 = prompt.shape
+    buf = jnp.zeros((B, T0 + n_new), jnp.int32)
+    buf = lax.dynamic_update_slice_in_dim(buf, prompt.astype(jnp.int32),
+                                          0, axis=1)
+
+    def step(buf, i):
+        logits = model.apply({"params": params}, buf, train=False)
+        prev = lax.dynamic_index_in_dim(logits, i - 1, axis=1,
+                                        keepdims=False)      # (B, V)
+        nxt = jnp.argmax(prev, axis=-1).astype(jnp.int32)    # (B,)
+        return buf.at[:, i].set(nxt), None
+
+    buf, _ = lax.scan(step, buf, T0 + jnp.arange(n_new))
+    return buf
