@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: test test-all dryrun bench smoke capture aot real-data
+.PHONY: test test-all dryrun bench smoke capture aot real-data lint trace-demo
 
 # Fast default loop (round-3 verdict item 5): skips the `slow`-marked
 # multi-process / end-to-end-CLI / AOT tests. CI and pre-commit should run
@@ -41,6 +41,32 @@ aot:
 # "no network egress" message; run it where egress exists.
 real-data:
 	$(PYTHON) -m tpu_ddp.tools.real_data
+
+# Static checks (config in pyproject.toml [tool.ruff]). Skips with a
+# notice when ruff isn't installed (this build container doesn't ship it;
+# CI should).
+lint:
+	@if $(PYTHON) -m ruff --version >/dev/null 2>&1; then \
+	  $(PYTHON) -m ruff check tpu_ddp tests; \
+	elif command -v ruff >/dev/null 2>&1; then \
+	  ruff check tpu_ddp tests; \
+	else \
+	  echo "lint: ruff not installed (pip install ruff); skipping"; \
+	fi
+
+# Telemetry smoke test for the whole pipeline: a 5-step CPU training run
+# with the JSONL + Chrome sinks + watchdog enabled, then the trace
+# summarized back into per-phase percentiles. The Chrome trace
+# (trace-p0.trace.json) loads in https://ui.perfetto.dev.
+TRACE_DEMO_DIR ?= /tmp/tpu_ddp_trace_demo
+trace-demo:
+	rm -rf $(TRACE_DEMO_DIR)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  $(PYTHON) -m tpu_ddp.cli.train --device cpu --synthetic-data \
+	  --synthetic-size 1280 --epochs 1 --log-every-epochs 1 \
+	  --telemetry-dir $(TRACE_DEMO_DIR) --watchdog-deadline 300
+	JAX_PLATFORMS=cpu $(PYTHON) -m tpu_ddp.cli.main trace summarize \
+	  $(TRACE_DEMO_DIR)
 
 # 2-epoch end-to-end CLI run on the virtual mesh (fast sanity check).
 smoke:

@@ -21,8 +21,15 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+import tpu_ddp.compat  # noqa: E402,F401  (jax.shard_map/typeof shims)
+
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax (<0.5): no such option — the XLA_FLAGS override above is
+    # the only (and sufficient) path to 8 virtual devices
+    pass
 
 import pytest  # noqa: E402
 

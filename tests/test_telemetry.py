@@ -1,0 +1,319 @@
+"""Telemetry subsystem: spans, registry, sinks, watchdog, summarize CLI.
+
+All CPU-only and fast (tier-1). The end-to-end test drives a real 5-step
+Trainer run with the JSONL + Chrome sinks on and asserts the acceptance
+contract: every step carries the data-wait / compiled-step / device-sync
+phases, the Chrome trace is valid trace_event JSON, and `tpu-ddp trace
+summarize` renders per-phase percentiles from the JSONL.
+"""
+
+import io
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tpu_ddp.telemetry import (
+    ChromeTraceSink,
+    HangWatchdog,
+    JsonlTraceSink,
+    Telemetry,
+    TerminalSummarySink,
+    build_telemetry,
+)
+from tpu_ddp.telemetry.events import SPAN, Clock
+from tpu_ddp.telemetry.registry import Registry
+
+
+class CaptureSink:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event):
+        self.events.append(event)
+
+    def close(self):
+        pass
+
+
+def test_span_nesting_and_timing_monotonic():
+    cap = CaptureSink()
+    tel = Telemetry([cap], registry=Registry())
+    with tel.span("outer", step=3):
+        with tel.span("inner"):
+            time.sleep(0.005)
+    inner, outer = cap.events  # spans emit on EXIT: inner closes first
+    assert inner.name == "inner" and outer.name == "outer"
+    assert inner.depth == 1 and outer.depth == 0
+    # containment: the inner span starts no earlier and ends no later
+    assert inner.ts_s >= outer.ts_s
+    assert inner.ts_s + inner.dur_s <= outer.ts_s + outer.dur_s + 1e-9
+    assert inner.dur_s >= 0.005
+    assert outer.dur_s >= inner.dur_s
+    assert outer.step == 3
+    # spans also feed the phase histograms
+    assert tel.registry.histogram("phase/inner").count == 1
+
+
+def test_registry_counter_gauge_histogram_aggregation():
+    reg = Registry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(4)
+    reg.gauge("g").set(2.5)
+    for v in [1.0, 2.0, 3.0, 4.0, 100.0]:
+        reg.histogram("h").record(v)
+    snap = reg.snapshot()
+    assert snap["counters"]["c"] == 5
+    assert snap["gauges"]["g"] == 2.5
+    h = snap["histograms"]["h"]
+    assert h["count"] == 5 and h["min"] == 1.0 and h["max"] == 100.0
+    assert h["p50"] == 3.0
+    assert h["p95"] == 100.0
+    assert np.isclose(h["mean"], 22.0)
+
+
+def test_jsonl_sink_schema_versioned_lines(tmp_path):
+    path = str(tmp_path / "trace.jsonl")
+    tel = Telemetry(
+        [JsonlTraceSink(path, clock=Clock())], registry=Registry()
+    )
+    with tel.span("phase_a", step=1):
+        pass
+    tel.instant("marker", note="x")
+    tel.emit_counters()
+    tel.close()
+    lines = [json.loads(ln) for ln in open(path)]  # every line valid JSON
+    assert lines[0]["type"] == "header" and "epoch_unix" in lines[0]
+    assert all(rec["schema_version"] == 1 for rec in lines)
+    kinds = [rec["type"] for rec in lines[1:]]
+    assert kinds.count("span") == 1
+    assert "instant" in kinds and "counters" in kinds
+    span = next(r for r in lines if r["type"] == "span")
+    assert span["name"] == "phase_a" and span["step"] == 1
+    assert span["dur_s"] >= 0
+
+
+def test_chrome_trace_sink_valid_trace_event_json(tmp_path):
+    path = str(tmp_path / "trace.trace.json")
+    clock = Clock()
+    tel = Telemetry(
+        [ChromeTraceSink(path, process_index=2)],
+        registry=Registry(), process_index=2, clock=clock,
+    )
+    with tel.span("compiled_step", step=7):
+        time.sleep(0.002)
+    tel.counter("train/steps").inc()
+    tel.emit_counters()
+    tel.close()
+    doc = json.loads(open(path).read())  # loadable == Perfetto-loadable
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    xs = [e for e in events if e["ph"] == "X"]
+    assert len(xs) == 1
+    (x,) = xs
+    assert x["name"] == "compiled_step"
+    assert x["pid"] == 2
+    assert isinstance(x["ts"], (int, float)) and x["ts"] >= 0
+    assert x["dur"] >= 2000  # microseconds
+    assert x["args"]["step"] == 7
+    counters = [e for e in events if e["ph"] == "C"]
+    assert any(c["name"] == "train/steps" for c in counters)
+
+
+def test_terminal_summary_sink_table():
+    out = io.StringIO()
+    tel = Telemetry([TerminalSummarySink(stream=out)], registry=Registry())
+    for _ in range(3):
+        with tel.span("data_wait"):
+            pass
+    tel.close()
+    table = out.getvalue()
+    assert "data_wait" in table
+    assert "p50_ms" in table and "p95_ms" in table
+
+
+def test_null_telemetry_is_inert(tmp_path):
+    tel = build_telemetry(None)
+    assert not tel.enabled
+    with tel.span("anything"):
+        pass
+    tel.instant("x")
+    tel.close()  # no files, no errors
+
+
+def test_build_telemetry_rejects_unknown_sink(tmp_path):
+    with pytest.raises(ValueError, match="unknown telemetry sink"):
+        build_telemetry(str(tmp_path), sinks="jsonl,bogus")
+
+
+def test_watchdog_fires_on_stalled_step(tmp_path):
+    dumps = []
+    cap = CaptureSink()
+    tel = Telemetry([cap], registry=Registry())
+    wd = HangWatchdog(
+        0.15,
+        heartbeat_dir=str(tmp_path),
+        telemetry=tel,
+        on_hang=dumps.append,
+        poll_interval=0.02,
+    ).start()
+    try:
+        wd.beat(step=12)
+        time.sleep(0.5)  # the "stalled step"
+    finally:
+        wd.stop()
+    assert wd.fired and wd.fire_count == 1  # one dump per stall episode
+    assert "thread" in dumps[0] and "tpu_ddp watchdog" in dumps[0]
+    # heartbeat file records the last completed step
+    hb = json.loads(open(tmp_path / "heartbeat-p0.json").read())
+    assert hb["step"] == 12
+    # hang forensics on disk + the telemetry instant
+    assert (tmp_path / "hang-p0.log").exists()
+    assert any(e.name == "watchdog_hang" for e in cap.events)
+    assert tel.registry.counter("watchdog/hangs").value == 1
+
+
+def test_watchdog_silent_on_healthy_run(tmp_path):
+    wd = HangWatchdog(0.3, poll_interval=0.02).start()
+    try:
+        for step in range(10):
+            wd.beat(step)
+            time.sleep(0.03)  # healthy cadence well inside the deadline
+    finally:
+        wd.stop()
+    assert not wd.fired
+
+
+def _write_trace(path, spans):
+    with open(path, "w") as f:
+        f.write(json.dumps({"schema_version": 1, "type": "header",
+                            "epoch_unix": 0.0, "pid": 0}) + "\n")
+        for name, dur in spans:
+            f.write(json.dumps({
+                "schema_version": 1, "type": SPAN, "name": name,
+                "ts_s": 0.0, "dur_s": dur, "pid": 0, "tid": 1, "depth": 0,
+            }) + "\n")
+
+
+def test_trace_summarize_cli(tmp_path, capsys):
+    from tpu_ddp.cli.main import main as cli_main
+
+    _write_trace(
+        tmp_path / "trace-p0.jsonl",
+        [("compiled_step", 0.010)] * 10 + [("compiled_step", 1.0)] * 10
+        + [("data_wait", 0.002)] * 20,
+    )
+    rc = cli_main(["trace", "summarize", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "compiled_step" in out and "data_wait" in out
+    assert "p50_ms" in out and "p95_ms" in out
+    # p50 of compiled_step is the 10ms mode; p95 catches the 1s outlier
+    row = next(ln for ln in out.splitlines()
+               if ln.startswith("compiled_step"))
+    cols = row.split()
+    assert float(cols[4]) == pytest.approx(10.0)    # p50_ms
+    assert float(cols[5]) == pytest.approx(1000.0)  # p95_ms
+
+
+def test_trace_summarize_cli_missing_dir(tmp_path, capsys):
+    from tpu_ddp.cli.main import main as cli_main
+
+    rc = cli_main(["trace", "summarize", str(tmp_path / "nope")])
+    assert rc == 2
+    assert "trace summarize" in capsys.readouterr().err
+
+
+def test_summarize_tolerates_torn_final_line(tmp_path):
+    from tpu_ddp.telemetry.summarize import summarize
+
+    path = tmp_path / "trace-p0.jsonl"
+    _write_trace(path, [("step", 0.5)])
+    with open(path, "a") as f:
+        f.write('{"schema_version": 1, "type": "span", "na')  # crash torn
+    out = summarize(str(tmp_path))
+    assert "step" in out
+
+
+def test_metric_logger_jsonl_schema_version(tmp_path, capsys):
+    from tpu_ddp.metrics.logging import MetricLogger
+
+    path = str(tmp_path / "metrics.jsonl")
+    logger = MetricLogger(jsonl_path=path)
+    logger.log(3, train_loss=1.25)
+    # crash-safety contract: the record is on disk BEFORE close
+    rec = json.loads(open(path).read().splitlines()[0])
+    logger.close()
+    assert rec["schema_version"] == 1
+    assert rec["step"] == 3 and rec["train_loss"] == 1.25
+    # the text format is unchanged by the schema field
+    assert "[step 3] train_loss=1.25" in capsys.readouterr().out
+
+
+@pytest.fixture(scope="module")
+def telemetry_run(tmp_path_factory):
+    """One 5-step CPU training run with JSONL+Chrome sinks + watchdog on
+    (shared across the end-to-end assertions below)."""
+    from tpu_ddp.train.trainer import TrainConfig, Trainer
+
+    run_dir = tmp_path_factory.mktemp("telemetry_run")
+    cfg = TrainConfig(
+        synthetic_data=True,
+        synthetic_size=320,   # 8 devices * per_shard 8 * 5 steps
+        per_shard_batch=8,
+        epochs=1,
+        n_chans1=4,
+        n_blocks=1,
+        log_every_epochs=1,
+        telemetry_dir=str(run_dir),
+        telemetry_sinks="jsonl,chrome",
+        watchdog_deadline_seconds=300.0,  # must stay silent
+    )
+    trainer = Trainer(cfg)
+    trainer.run()
+    return run_dir
+
+
+def test_trainer_emits_phase_spans_per_step(devices, telemetry_run):
+    records = [json.loads(ln)
+               for ln in open(telemetry_run / "trace-p0.jsonl")]
+    spans = [r for r in records if r["type"] == "span"]
+    by_step = {}
+    for s in spans:
+        if s["name"] in ("data_wait", "compiled_step", "device_sync"):
+            by_step.setdefault(s["step"], set()).add(s["name"])
+    # acceptance: every one of the 5 steps carries all three phases
+    full = {s for s, names in by_step.items()
+            if names >= {"data_wait", "compiled_step", "device_sync"}}
+    assert len(full) == 5, by_step
+    # the counters snapshot saw all 5 steps and the recompile counter moved
+    counters = [r for r in records if r["type"] == "counters"][-1]
+    assert counters["attrs"]["counters"]["train/steps"] == 5
+    assert counters["attrs"]["counters"].get("jax/compilations", 0) > 0
+    # watchdog stayed silent on the healthy run
+    assert not any(r["name"] == "watchdog_hang" for r in records
+                   if r["type"] == "instant")
+    hb = json.loads(open(telemetry_run / "heartbeat-p0.json").read())
+    assert hb["step"] == 5
+
+
+def test_trainer_chrome_trace_perfetto_loadable(devices, telemetry_run):
+    doc = json.loads(open(telemetry_run / "trace-p0.trace.json").read())
+    events = doc["traceEvents"]
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert {"data_wait", "compiled_step", "device_sync"} <= {
+        e["name"] for e in xs
+    }
+    for e in xs:
+        assert isinstance(e["ts"], (int, float))
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+
+
+def test_trainer_run_dir_summarizes(devices, telemetry_run, capsys):
+    from tpu_ddp.cli.main import main as cli_main
+
+    assert cli_main(["trace", "summarize", str(telemetry_run)]) == 0
+    out = capsys.readouterr().out
+    for phase in ("data_wait", "compiled_step", "device_sync"):
+        assert phase in out

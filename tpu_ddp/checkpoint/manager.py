@@ -19,8 +19,11 @@ class Checkpointer:
     # intent record for save_as_only's delete sweep (see _sweep_stale)
     _ONLY_MARKER = "only_step.json"
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    def __init__(self, directory: str, max_to_keep: int = 3, telemetry=None):
         self.directory = os.path.abspath(directory)
+        if telemetry is None:
+            from tpu_ddp.telemetry import NULL as telemetry
+        self.telemetry = telemetry
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -51,9 +54,14 @@ class Checkpointer:
         # a plain save declares max-step retention meaningful again: drop
         # any leftover save_as_only intent so it can't shadow this step
         self._clear_marker()
-        self.manager.save(step, args=ocp.args.StandardSave(state))
-        if wait:
-            self.manager.wait_until_finished()
+        # the span covers save INITIATION (orbax saves are async unless
+        # wait=True): a long "checkpoint" slice in the trace means the
+        # save path itself is blocking training, not background IO
+        with self.telemetry.span("checkpoint", step=step, wait=wait):
+            self.manager.save(step, args=ocp.args.StandardSave(state))
+            if wait:
+                self.manager.wait_until_finished()
+        self.telemetry.count("checkpoint/saves")
 
     def save_as_only(self, step: int, state: Any) -> None:
         """Replace whatever checkpoints exist with this one. The best-
@@ -91,8 +99,12 @@ class Checkpointer:
             with open(tmp, "w") as f:
                 json.dump({"step": int(step)}, f)
             os.replace(tmp, marker)
-        self.manager.save(step, args=ocp.args.StandardSave(state), force=True)
-        self.manager.wait_until_finished()
+        with self.telemetry.span("checkpoint", step=step, best=True):
+            self.manager.save(
+                step, args=ocp.args.StandardSave(state), force=True
+            )
+            self.manager.wait_until_finished()
+        self.telemetry.count("checkpoint/saves")
         for s in self.manager.all_steps():
             if s != step:
                 self.manager.delete(s)
@@ -112,7 +124,10 @@ class Checkpointer:
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_template)
-        return self.manager.restore(step, args=ocp.args.StandardRestore(abstract))
+        with self.telemetry.span("checkpoint_restore", step=step):
+            return self.manager.restore(
+                step, args=ocp.args.StandardRestore(abstract)
+            )
 
     def close(self) -> None:
         self.manager.wait_until_finished()

@@ -123,14 +123,41 @@ def _terminate_all(procs: Sequence[subprocess.Popen],
                 p.wait()
 
 
+def _job_telemetry(telemetry_dir: Optional[str], node_rank: int):
+    """Launcher-side telemetry (job lifecycle events into a per-node JSONL
+    trace). tpu_ddp.telemetry.core/sinks are stdlib-only by contract, so
+    this keeps the launcher's no-jax guarantee; None -> the disabled NULL
+    instance."""
+    if not telemetry_dir:
+        from tpu_ddp.telemetry import NULL
+
+        return NULL
+    import os as _os
+
+    from tpu_ddp.telemetry import JsonlTraceSink, Telemetry
+    from tpu_ddp.telemetry.events import Clock
+
+    clock = Clock()
+    sink = JsonlTraceSink(
+        _os.path.join(telemetry_dir, f"launch-n{node_rank}.jsonl"),
+        clock=clock, process_index=node_rank,
+    )
+    return Telemetry([sink], process_index=node_rank, clock=clock)
+
+
 def run_job(cmd: Sequence[str], *, nnodes: int = 1, nproc_per_node: int = 1,
             node_rank: int = 0, coordinator: Optional[str] = None,
-            env: Optional[dict] = None) -> int:
+            env: Optional[dict] = None,
+            telemetry_dir: Optional[str] = None) -> int:
     """Launch ``cmd`` once per local rank and supervise until all exit.
 
     Returns the job's exit code: 0 iff every child exited 0, else the
     first failing child's code (with the rest torn down torchrun-style).
+    With ``telemetry_dir``, job lifecycle events (spawn/exit per rank,
+    forwarded signals, final rc) land in ``launch-n<node>.jsonl`` there —
+    the supervisor's side of the story next to the ranks' traces.
     """
+    tel = _job_telemetry(telemetry_dir, node_rank)
     if coordinator is None:
         if nnodes > 1:
             raise ValueError("--coordinator host:port is required when "
@@ -143,8 +170,13 @@ def run_job(cmd: Sequence[str], *, nnodes: int = 1, nproc_per_node: int = 1,
     ranks = plan_ranks(nnodes, nproc_per_node, node_rank)
 
     forwarded = []
+    forwarded_logged = 0
 
     def _forward(signum, frame):
+        # async-signal-safe only: no sink IO here (JsonlTraceSink holds a
+        # non-reentrant lock the interrupted main thread may own — the
+        # same rule as the trainer's _on_signal). The supervise loop
+        # emits the telemetry instant after the handler returns.
         forwarded.append(signum)
         for p in procs:
             if p.poll() is None:
@@ -156,6 +188,10 @@ def run_job(cmd: Sequence[str], *, nnodes: int = 1, nproc_per_node: int = 1,
     prev = {s: signal.signal(s, _forward)
             for s in (signal.SIGTERM, signal.SIGINT)}
     try:
+        tel.instant(
+            "job_start", nnodes=nnodes, nproc_per_node=nproc_per_node,
+            node_rank=node_rank, coordinator=coordinator,
+        )
         for process_id, local_rank in ranks:
             procs.append(subprocess.Popen(
                 list(cmd),
@@ -164,11 +200,21 @@ def run_job(cmd: Sequence[str], *, nnodes: int = 1, nproc_per_node: int = 1,
                               process_id=process_id, local_rank=local_rank,
                               nproc_per_node=nproc_per_node),
             ))
+            tel.instant(
+                "child_spawn", process_id=process_id,
+                local_rank=local_rank, os_pid=procs[-1].pid,
+            )
         rc = 0
         live = list(procs)
         escalate_at = None
         while live:
             time.sleep(0.1)
+            while forwarded_logged < len(forwarded):
+                tel.instant(
+                    "signal_forwarded",
+                    signum=int(forwarded[forwarded_logged]),
+                )
+                forwarded_logged += 1
             if forwarded and escalate_at is None:
                 # a forwarded preemption gets ONE grace window for the
                 # cooperative drain; a rank wedged in a collective (peer
@@ -183,6 +229,7 @@ def run_job(cmd: Sequence[str], *, nnodes: int = 1, nproc_per_node: int = 1,
                 if code is None:
                     continue
                 live.remove(p)
+                tel.instant("child_exit", os_pid=p.pid, code=code)
                 if code != 0 and rc == 0:
                     # one failed rank fails the job — INCLUDING during a
                     # forwarded preemption: a rank that crashed instead of
@@ -193,11 +240,14 @@ def run_job(cmd: Sequence[str], *, nnodes: int = 1, nproc_per_node: int = 1,
                     _terminate_all(live)
         # signal-style exits surface as the shell convention 128+N so the
         # caller sees e.g. 137 rather than a negative code
-        return 128 - rc if rc < 0 else rc
+        rc = 128 - rc if rc < 0 else rc
+        tel.instant("job_end", rc=rc)
+        return rc
     finally:
         _terminate_all(procs)
         for s, h in prev.items():
             signal.signal(s, h)
+        tel.close()
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -217,6 +267,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--coordinator", default=None, metavar="HOST:PORT",
                     help="rendezvous address (node 0's reachable address); "
                     "auto-picked on localhost for single-node jobs")
+    ap.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                    help="write launcher job-lifecycle events "
+                    "(spawn/exit/signals) to launch-n<node>.jsonl here; "
+                    "pass the same dir to the train CLI's --telemetry-dir "
+                    "for a combined picture")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="command to launch, after `--`: python main.py ...")
     args = ap.parse_args(argv)
@@ -229,7 +284,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                  "python main.py ...")
     return run_job(cmd, nnodes=args.nnodes,
                    nproc_per_node=args.nproc_per_node,
-                   node_rank=args.node_rank, coordinator=args.coordinator)
+                   node_rank=args.node_rank, coordinator=args.coordinator,
+                   telemetry_dir=args.telemetry_dir)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,70 @@
+"""``tpu-ddp`` — the umbrella CLI.
+
+Subcommands:
+
+- ``tpu-ddp train ...``   — the training CLI (same flags as tpu-ddp-train)
+- ``tpu-ddp launch ...``  — the multi-process launcher (tpu-ddp-launch)
+- ``tpu-ddp trace summarize <run_dir>`` — aggregate a telemetry JSONL
+  trace into per-phase percentiles (p50/p95/max) and the final
+  counters/gauges snapshot.
+
+``trace summarize`` is stdlib-only end to end (no jax import): traces are
+summarized wherever they land — a laptop, a CI box, the pod host itself.
+The train/launch subcommands import lazily so `trace` keeps that property.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+
+def _trace_summarize(args) -> int:
+    from tpu_ddp.telemetry.summarize import summarize
+
+    try:
+        print(summarize(args.path))
+    except (FileNotFoundError, ValueError) as e:
+        print(f"tpu-ddp trace summarize: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # train/launch own their argparse surface: hand the remainder through
+    # untouched so `tpu-ddp train --help` shows the full trainer surface
+    if argv[:1] == ["train"]:
+        from tpu_ddp.cli.train import main as train_main
+
+        train_main(argv[1:])
+        return 0
+    if argv[:1] == ["launch"]:
+        from tpu_ddp.cli.launch import main as launch_main
+
+        return launch_main(argv[1:])
+
+    ap = argparse.ArgumentParser(
+        prog="tpu-ddp",
+        description="tpu_ddp umbrella CLI (train / launch / trace)",
+    )
+    sub = ap.add_subparsers(dest="command", required=True)
+    sub.add_parser("train", help="run the trainer (tpu-ddp train --help)")
+    sub.add_parser("launch", help="multi-process launcher "
+                                  "(tpu-ddp launch --help)")
+    trace = sub.add_parser("trace", help="telemetry trace tools")
+    trace_sub = trace.add_subparsers(dest="trace_command", required=True)
+    summ = trace_sub.add_parser(
+        "summarize",
+        help="per-phase p50/p95 table from a run dir's JSONL trace",
+    )
+    summ.add_argument("path", help="run dir (holding trace-p*.jsonl) or a "
+                                   "trace file")
+    summ.set_defaults(func=_trace_summarize)
+    args = ap.parse_args(argv)
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

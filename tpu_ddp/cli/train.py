@@ -162,6 +162,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None,
                    help="emit an XLA/TPU profiler trace (TensorBoard/"
                         "Perfetto) for one steady-state epoch")
+    p.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                   help="enable structured telemetry into this run dir: "
+                        "per-host schema-versioned JSONL trace + Chrome "
+                        "trace_event JSON (Perfetto-loadable) + terminal "
+                        "phase summary; read back with `tpu-ddp trace "
+                        "summarize DIR`. Adds a per-step device fence "
+                        "for phase attribution")
+    p.add_argument("--telemetry-sinks", default="jsonl,chrome,summary",
+                   metavar="LIST",
+                   help="comma-separated subset of jsonl,chrome,summary")
+    p.add_argument("--watchdog-deadline", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help=">0: hang watchdog — every host writes a "
+                        "heartbeat file (under --telemetry-dir) per step "
+                        "and dumps all thread stacks when no step "
+                        "completes within the deadline (multihost wedge "
+                        "forensics)")
     p.add_argument("--compilation-cache-dir", default=None, metavar="DIR",
                    help="persistent XLA compilation cache: repeat runs skip "
                         "the 20-40s first-compile (cache is keyed on "
@@ -314,6 +331,9 @@ def config_from_args(args) -> TrainConfig:
         jsonl_path=args.jsonl,
         tensorboard_dir=args.tensorboard_dir,
         profile_dir=args.profile_dir,
+        telemetry_dir=args.telemetry_dir,
+        telemetry_sinks=args.telemetry_sinks,
+        watchdog_deadline_seconds=args.watchdog_deadline,
         freeze_prefixes=tuple(args.freeze) if args.freeze else None,
         loss=args.loss,
         label_smoothing=args.label_smoothing,

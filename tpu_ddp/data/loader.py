@@ -84,6 +84,7 @@ class ShardedBatchLoader:
         exclude_sampler_pad: bool = False,
         process_index: int = 0,
         process_count: int = 1,
+        telemetry=None,
     ):
         """exclude_sampler_pad: also mask out the sampler-level wrap-pad
         duplicates (the samples DistributedSampler repeats to even out
@@ -101,7 +102,12 @@ class ShardedBatchLoader:
         host-resident in full here (CIFAR-scale); for datasets too large
         per host, pre-shard files per process and run with
         ``shuffle`` local to each host's shard — the sampler sees the
-        host-local array and ``process_count=1`` semantics apply per host."""
+        host-local array and ``process_count=1`` semantics apply per host.
+
+        telemetry: optional ``tpu_ddp.telemetry.Telemetry`` — the loader
+        emits a ``data_gather`` span per assembled batch and counts
+        ``loader/batches`` (stdlib-only import, keeps this module
+        jax-free)."""
         assert len(images) == len(labels)
         assert world_size % process_count == 0, (
             f"{world_size} devices not divisible by {process_count} hosts"
@@ -116,6 +122,9 @@ class ShardedBatchLoader:
         self.exclude_sampler_pad = exclude_sampler_pad
         self.process_index = process_index
         self.process_count = process_count
+        if telemetry is None:
+            from tpu_ddp.telemetry import NULL as telemetry
+        self.telemetry = telemetry
         self.local_world_size = world_size // process_count
         self._epoch = 0
         per_shard = math.ceil(len(images) / world_size)
@@ -182,11 +191,14 @@ class ShardedBatchLoader:
 
     def epoch_batches(self, epoch: Optional[int] = None) -> Iterator[Dict[str, np.ndarray]]:
         for idx, mask in self.epoch_index_batches(epoch):
-            yield {
-                "image": _gather(self.images, idx),
-                "label": _gather(self.labels, idx),
-                "mask": mask,
-            }
+            with self.telemetry.span("data_gather"):
+                batch = {
+                    "image": _gather(self.images, idx),
+                    "label": _gather(self.labels, idx),
+                    "mask": mask,
+                }
+            self.telemetry.count("loader/batches")
+            yield batch
 
     def __iter__(self):
         return self.epoch_batches()

@@ -10,6 +10,12 @@ the dead ``free_gpu_cache``/GPUtil code, ``main.py:67-78``), and a
 
 from tpu_ddp.metrics.logging import MetricLogger
 from tpu_ddp.metrics.timing import StepTimer, Throughput
-from tpu_ddp.metrics.memory import device_memory_stats
+from tpu_ddp.metrics.memory import device_memory_stats, record_memory_gauges
 
-__all__ = ["MetricLogger", "StepTimer", "Throughput", "device_memory_stats"]
+__all__ = [
+    "MetricLogger",
+    "StepTimer",
+    "Throughput",
+    "device_memory_stats",
+    "record_memory_gauges",
+]

@@ -9,6 +9,10 @@ from typing import Optional
 
 from tpu_ddp.parallel.runtime import is_primary_process
 
+#: Version of the metrics-JSONL record shape (one bump per breaking
+#: change; consumers should skip records from a future version).
+SCHEMA_VERSION = 1
+
 
 class MetricLogger:
     """Scalars -> stdout (+ optional JSONL file, + optional TensorBoard
@@ -27,7 +31,7 @@ class MetricLogger:
         self._tb = None
         if jsonl_path and is_primary_process():
             os.makedirs(os.path.dirname(jsonl_path) or ".", exist_ok=True)
-            self._fh = open(jsonl_path, "a", buffering=1)
+            self._fh = open(jsonl_path, "a")
         if tensorboard_dir and is_primary_process():
             try:
                 from torch.utils.tensorboard import SummaryWriter
@@ -41,15 +45,25 @@ class MetricLogger:
     def log(self, step: int, **scalars) -> None:
         if not is_primary_process():
             return
-        record = {"step": step, "time": time.time(), **scalars}
+        record = {
+            "schema_version": SCHEMA_VERSION,
+            "step": step,
+            "time": time.time(),
+            **scalars,
+        }
         if self.stdout:
+            # text format unchanged: schema_version is a JSONL-only field
             pretty = " ".join(
                 f"{k}={v:.6g}" if isinstance(v, float) else f"{k}={v}"
                 for k, v in scalars.items()
             )
             print(f"[step {step}] {pretty}", flush=True)
         if self._fh:
+            # explicit per-line flush (not just line buffering): a crash —
+            # or a preemption SIGKILL after the grace window — loses at
+            # most the record being written, never a buffered batch
             self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
         if self._tb:
             for k, v in scalars.items():
                 if isinstance(v, (int, float)):

@@ -25,3 +25,23 @@ def device_memory_stats() -> list:
             }
         )
     return out
+
+
+def record_memory_gauges(registry) -> None:
+    """Thin adapter over the telemetry registry: publish the local devices'
+    HBM picture as gauges — worst-chip high-water (the OOM predictor),
+    current total in use, and the limit. No-op fields on backends without
+    memory_stats (CPU) are simply skipped."""
+    stats = device_memory_stats()
+    peaks = [s["peak_bytes_in_use"] for s in stats
+             if s["peak_bytes_in_use"] is not None]
+    in_use = [s["bytes_in_use"] for s in stats
+              if s["bytes_in_use"] is not None]
+    limits = [s["bytes_limit"] for s in stats
+              if s["bytes_limit"] is not None]
+    if peaks:
+        registry.gauge("memory/peak_bytes_in_use_max").set(max(peaks))
+    if in_use:
+        registry.gauge("memory/bytes_in_use_total").set(sum(in_use))
+    if limits:
+        registry.gauge("memory/bytes_limit_per_device").set(min(limits))

@@ -66,6 +66,13 @@ def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
         return None
 
 
+def record_mfu(registry, mfu_value: Optional[float]) -> None:
+    """Thin adapter over the telemetry registry: publish MFU as the
+    ``train/mfu`` gauge (skipped when no peak figure exists — CPU runs)."""
+    if mfu_value is not None:
+        registry.gauge("train/mfu").set(mfu_value)
+
+
 def mfu(flops_per_call: Optional[float], calls_per_sec: float,
         device=None) -> Optional[float]:
     """Fraction of peak: (per-device flops/call * calls/sec) / per-chip peak.

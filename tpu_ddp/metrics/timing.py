@@ -17,12 +17,21 @@ import jax
 
 
 class StepTimer:
-    def __init__(self, warmup_steps: int = 2):
+    """Per-step intervals with warmup exclusion. With a telemetry
+    ``registry``, each post-warmup interval also lands in the
+    ``step_seconds`` histogram — the thin-adapter layering: this class
+    keeps its API, the registry gets the distribution."""
+
+    def __init__(self, warmup_steps: int = 2, registry=None):
         self.warmup_steps = warmup_steps
         self._seen = 0
         self._total = 0.0
         self._steps = 0
         self._last: Optional[float] = None
+        self._hist = (
+            registry.histogram("step_seconds") if registry is not None
+            else None
+        )
 
     def tick(self) -> None:
         now = time.perf_counter()
@@ -31,6 +40,8 @@ class StepTimer:
             if self._seen > self.warmup_steps:
                 self._total += now - self._last
                 self._steps += 1
+                if self._hist is not None:
+                    self._hist.record(now - self._last)
         self._last = now
 
     @property
@@ -39,13 +50,20 @@ class StepTimer:
 
 
 class Throughput:
-    """Steady-state images/sec/chip over a timed region."""
+    """Steady-state images/sec/chip over a timed region.
 
-    def __init__(self, n_chips: Optional[int] = None):
+    With a telemetry ``registry``, ``stop`` publishes the
+    ``throughput/images_per_sec`` and ``throughput/images_per_sec_per_chip``
+    gauges (counting raw images is the trainer's job — it owns the
+    ``train/images`` counter).
+    """
+
+    def __init__(self, n_chips: Optional[int] = None, registry=None):
         self.n_chips = n_chips or jax.device_count()
         self._images = 0
         self._start: Optional[float] = None
         self._elapsed = 0.0
+        self._registry = registry
 
     def start(self) -> None:
         self._start = time.perf_counter()
@@ -59,10 +77,22 @@ class Throughput:
         assert self._start is not None
         self._elapsed += time.perf_counter() - self._start
         self._start = None
+        if self._registry is not None:
+            self._registry.gauge("throughput/images_per_sec").set(
+                self.images_per_sec
+            )
+            self._registry.gauge("throughput/images_per_sec_per_chip").set(
+                self.images_per_sec_per_chip
+            )
 
     @property
     def images_per_sec(self) -> float:
-        return self._images / self._elapsed if self._elapsed else float("nan")
+        """Rate over time observed so far — valid mid-run too (the running
+        window is included), so epoch-boundary gauges are meaningful."""
+        elapsed = self._elapsed
+        if self._start is not None:
+            elapsed += time.perf_counter() - self._start
+        return self._images / elapsed if elapsed else float("nan")
 
     @property
     def images_per_sec_per_chip(self) -> float:

@@ -35,6 +35,8 @@ from __future__ import annotations
 import functools
 
 import jax
+
+import tpu_ddp.compat  # noqa: F401  (jax.shard_map/typeof shims)
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl

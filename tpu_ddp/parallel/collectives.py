@@ -14,6 +14,8 @@ the axis bound.
 from __future__ import annotations
 
 import jax
+
+import tpu_ddp.compat  # noqa: F401  (jax.shard_map/typeof shims)
 from jax import lax
 
 

@@ -30,6 +30,8 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+
+import tpu_ddp.compat  # noqa: F401  (jax.shard_map/typeof shims)
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -37,6 +39,7 @@ from flax import linen as nn
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_ddp.compat import GRAD_SYNC_IN_AD
 from tpu_ddp.models.vit import TransformerBlock
 from tpu_ddp.parallel.mesh import DATA_AXIS, PIPELINE_AXIS
 from tpu_ddp.train.losses import cross_entropy_loss, masked_accuracy
@@ -236,12 +239,40 @@ def make_pp_train_step(
     def compute_loss(params, batch):
         logits = forward(params, batch["image"])
         loss = loss_fn(logits, batch["label"], batch.get("mask"))
-        return lax.pmean(loss, data_axis), logits
+        if GRAD_SYNC_IN_AD:
+            loss = lax.pmean(loss, data_axis)
+        else:
+            # SHIMMED: old jax transposes forward's logits psum back to a
+            # psum, so the n_stages identical per-stage loss seeds re-sum
+            # into an n_stages over-count of every cotangent; pre-scaling
+            # the differentiated value cancels it (metric rescaled below)
+            loss = loss / n_stages
+        return loss, logits
 
     def shard_step(state: TrainState, batch):
         (loss, logits), grads = jax.value_and_grad(compute_loss, has_aux=True)(
             state.params, batch
         )
+        if not GRAD_SYNC_IN_AD:
+            loss = loss * n_stages
+            # the explicit version of what AD-of-pmean inserts on modern
+            # jax (mirrors the 1F1B manual backward): stage-sharded
+            # `blocks` grads only DDP-average over data; replicated params
+            # (embed/head) are each nonzero on exactly one stage, so their
+            # grads psum over the pipeline axis first
+            grads = {
+                k: (
+                    jax.tree.map(lambda g: lax.pmean(g, data_axis), v)
+                    if k == "blocks"
+                    else jax.tree.map(
+                        lambda g: lax.pmean(
+                            lax.psum(g, pipe_axis), data_axis
+                        ), v,
+                    )
+                )
+                for k, v in grads.items()
+            }
+            loss = lax.pmean(loss, data_axis)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         correct, count = masked_accuracy(logits, batch["label"], batch.get("mask"))

@@ -19,11 +19,14 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+
+import tpu_ddp.compat  # noqa: F401  (jax.shard_map/typeof shims)
 import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_ddp.compat import GRAD_SYNC_IN_AD
 from tpu_ddp.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
 from tpu_ddp.train.losses import cross_entropy_loss
 from tpu_ddp.train.state import TrainState
@@ -53,11 +56,23 @@ def make_sp_train_step(
         # AD). Over `data_axis` ONLY: the SP model's mean-pool pmean already
         # made the loss invariant over `seq_axis`, and shard_map's
         # varying-axes tracking inserts the correct sequence-axis psums for
-        # the distributed attention partials during the transpose.
-        return lax.pmean(loss, data_axis)
+        # the distributed attention partials during the transpose. SHIMMED
+        # jax: both collectives move to the explicit grad sync below.
+        return lax.pmean(loss, data_axis) if GRAD_SYNC_IN_AD else loss
 
     def shard_step(state: TrainState, batch):
         loss, grads = jax.value_and_grad(compute_loss)(state.params, batch)
+        if not GRAD_SYNC_IN_AD:
+            # On old jax, psum transposes to psum: the n_seq identical
+            # replicated-loss seeds re-sum through the model's pooling
+            # psum, so every partial arrives n_seq-fold — pmean (not
+            # psum) over the ring both sums the per-shard partials and
+            # cancels that factor; then DDP-average over data.
+            grads = jax.tree.map(
+                lambda g: lax.pmean(lax.pmean(g, seq_axis), data_axis),
+                grads,
+            )
+            loss = lax.pmean(loss, data_axis)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         return (

@@ -1,0 +1,113 @@
+"""Structured telemetry: step-phase tracing, counters, sinks, watchdog.
+
+The observability substrate of the framework (the window `MetricLogger`
+text lines never gave): the trainer, data loader, checkpoint manager, and
+launch CLI all emit into one ``Telemetry`` object, which fans out to
+pluggable sinks:
+
+- ``jsonl`` — schema-versioned JSON Lines (``trace-p<host>.jsonl``),
+  flushed per line; read back by ``tpu-ddp trace summarize``.
+- ``chrome`` — Chrome trace_event JSON (``trace-p<host>.trace.json``),
+  loadable in Perfetto.
+- ``summary`` — per-phase duration table printed at run end.
+
+Alongside: a process-wide counters/gauges/histograms registry (recompiles
+via jax.monitoring, steps/sec, images/sec/chip, HBM high-water), and a
+multihost hang watchdog (heartbeat file per host + stack dump on stall).
+
+Everything except ``jax_hooks`` is stdlib-only: the launcher emits job
+events from a process that must never import jax, and traces summarize on
+any machine. See ``docs/telemetry.md``.
+"""
+
+from tpu_ddp.telemetry.core import NULL, Telemetry
+from tpu_ddp.telemetry.events import SCHEMA_VERSION, Clock, Event
+from tpu_ddp.telemetry.registry import (
+    Registry,
+    default_registry,
+    reset_default_registry,
+)
+from tpu_ddp.telemetry.sinks import (
+    ChromeTraceSink,
+    JsonlTraceSink,
+    Sink,
+    TerminalSummarySink,
+)
+from tpu_ddp.telemetry.watchdog import HangWatchdog
+
+#: Default sink set when a run dir is given but no sink list.
+DEFAULT_SINKS = "jsonl,chrome,summary"
+
+
+def build_telemetry(
+    run_dir,
+    sinks: str = DEFAULT_SINKS,
+    *,
+    process_index: int = 0,
+    jax_hooks: bool = True,
+) -> Telemetry:
+    """Construct a Telemetry for ``run_dir`` with the named sinks
+    (comma-separated subset of ``jsonl,chrome,summary``), or the disabled
+    ``NULL`` instance when ``run_dir`` is falsy.
+
+    Per-host trace files (``trace-p<i>.jsonl`` / ``trace-p<i>.trace.json``)
+    keep multihost runs collision-free in a shared run dir; the terminal
+    summary only prints from process 0.
+    """
+    if not run_dir:
+        return NULL
+    import os
+
+    os.makedirs(run_dir, exist_ok=True)
+    clock = Clock()
+    built = []
+    names = [s.strip() for s in (sinks or DEFAULT_SINKS).split(",") if s.strip()]
+    for name in names:
+        if name == "jsonl":
+            built.append(JsonlTraceSink(
+                os.path.join(run_dir, f"trace-p{process_index}.jsonl"),
+                clock=clock, process_index=process_index,
+            ))
+        elif name == "chrome":
+            built.append(ChromeTraceSink(
+                os.path.join(run_dir, f"trace-p{process_index}.trace.json"),
+                process_index=process_index,
+            ))
+        elif name == "summary":
+            if process_index == 0:
+                built.append(TerminalSummarySink())
+        else:
+            raise ValueError(
+                f"unknown telemetry sink {name!r} "
+                f"(expected a subset of {DEFAULT_SINKS})"
+            )
+    tel = Telemetry(built, process_index=process_index, clock=clock)
+    if jax_hooks:
+        # lazy + best-effort: only bridges jax.monitoring when jax is
+        # importable in this process (never true in the launcher)
+        try:
+            from tpu_ddp.telemetry.jax_hooks import install_jax_hooks
+
+            install_jax_hooks()
+        except Exception:
+            pass
+    return tel
+
+
+__all__ = [
+    "NULL",
+    "Telemetry",
+    "Clock",
+    "Event",
+    "SCHEMA_VERSION",
+    "Registry",
+    "default_registry",
+    "reset_default_registry",
+    "Sink",
+    "JsonlTraceSink",
+    "ChromeTraceSink",
+    "TerminalSummarySink",
+    "HangWatchdog",
+    "DEFAULT_SINKS",
+    "build_telemetry",
+]

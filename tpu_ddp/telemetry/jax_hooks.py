@@ -1,0 +1,63 @@
+"""jax.monitoring -> registry bridge: recompile counting.
+
+A slow step is often a *recompiling* step (a shape leaked into a jit
+boundary, a donated buffer changed layout). jax reports every backend
+compile through ``jax.monitoring``; this module counts them — and their
+total seconds — into the process-wide registry so the per-step trace can
+be cross-read against ``jax/compilations`` moving.
+
+Verified event names on the jax series this targets:
+  - ``/jax/core/compile/backend_compile_duration`` (duration listener):
+    fires once per XLA backend compile — the recompile signal.
+  - ``/jax/compilation_cache/...`` (event listener): persistent-cache
+    traffic, counted per event name.
+
+Kept separate from telemetry.core so everything else in the package stays
+importable without jax (launcher, summarize CLI).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpu_ddp.telemetry.registry import default_registry
+
+log = logging.getLogger(__name__)
+
+_installed = False
+
+
+def install_jax_hooks() -> bool:
+    """Register jax.monitoring listeners feeding the default registry.
+
+    Idempotent (listeners are process-global and cannot be unregistered,
+    so they are installed once and always write to ``default_registry()``
+    — which tests may swap via ``reset_default_registry``). Returns True
+    when the hooks are (already) installed, False when this jax has no
+    monitoring API.
+    """
+    global _installed
+    if _installed:
+        return True
+    try:
+        from jax import monitoring
+    except ImportError:
+        return False
+    if not hasattr(monitoring, "register_event_duration_secs_listener"):
+        return False
+
+    def _on_duration(name: str, duration: float, **kw) -> None:
+        if name.endswith("backend_compile_duration"):
+            reg = default_registry()
+            reg.counter("jax/compilations").inc()
+            reg.histogram("jax/compile_seconds").record(duration)
+
+    def _on_event(name: str, **kw) -> None:
+        if name.startswith("/jax/compilation_cache/"):
+            short = name[len("/jax/"):].replace("compilation_cache/", "")
+            default_registry().counter(f"jax/cache/{short}").inc()
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    monitoring.register_event_listener(_on_event)
+    _installed = True
+    return True

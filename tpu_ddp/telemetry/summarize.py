@@ -1,0 +1,117 @@
+"""Aggregate a JSONL trace into per-phase percentiles.
+
+Backs ``tpu-ddp trace summarize <run_dir>``: reads the schema-versioned
+JSONL trace(s) a run wrote (``trace-p*.jsonl``), buckets span durations by
+phase name, and renders the same table the terminal summary sink prints
+live. Stdlib-only so it runs anywhere the trace files land.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, Iterable, List
+
+from tpu_ddp.telemetry.events import SCHEMA_VERSION, SPAN
+from tpu_ddp.telemetry.registry import Histogram
+from tpu_ddp.telemetry.sinks import format_phase_table
+
+
+def find_trace_files(path: str) -> List[str]:
+    """Resolve a summarize target: a trace file itself, or a run dir
+    holding ``trace-p*.jsonl`` (one per host)."""
+    if os.path.isfile(path):
+        return [path]
+    if os.path.isdir(path):
+        hits = sorted(glob.glob(os.path.join(path, "trace-p*.jsonl")))
+        if hits:
+            return hits
+        # tolerate a bare trace.jsonl (hand-rolled runs)
+        flat = os.path.join(path, "trace.jsonl")
+        if os.path.isfile(flat):
+            return [flat]
+    raise FileNotFoundError(
+        f"no JSONL trace under {path!r} (expected trace-p*.jsonl)"
+    )
+
+
+def read_records(paths: Iterable[str]) -> List[dict]:
+    """Parse JSONL records, skipping torn trailing lines (a crash mid-write
+    leaves at most one) and refusing records from a future schema."""
+    records: List[dict] = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn final line from a crash — expected
+                version = rec.get("schema_version")
+                if version is not None and version > SCHEMA_VERSION:
+                    raise ValueError(
+                        f"{path}: trace schema_version {version} is newer "
+                        f"than this tool understands ({SCHEMA_VERSION})"
+                    )
+                records.append(rec)
+    return records
+
+
+def aggregate_phases(records: Iterable[dict]) -> Dict[str, Histogram]:
+    """Span records -> {phase: Histogram of durations (seconds)}."""
+    phases: Dict[str, Histogram] = {}
+    for rec in records:
+        if rec.get("type") != SPAN:
+            continue
+        name = rec.get("name")
+        dur = rec.get("dur_s")
+        if not isinstance(name, str) or not isinstance(dur, (int, float)):
+            continue
+        phases.setdefault(name, Histogram()).record(dur)
+    return phases
+
+
+def last_counters(records: Iterable[dict]) -> Dict[int, dict]:
+    """Final counters snapshot PER HOST ({pid: attrs}): counters are
+    per-process registries, so a multihost run dir has one final snapshot
+    per trace file — showing only one would silently drop the rest."""
+    snaps: Dict[int, dict] = {}
+    for rec in records:
+        if rec.get("type") == "counters" and rec.get("attrs") is not None:
+            snaps[rec.get("pid", 0)] = rec["attrs"]
+    return snaps
+
+
+def summarize(path: str) -> str:
+    """Human-readable summary of a run dir / trace file."""
+    files = find_trace_files(path)
+    records = read_records(files)
+    phases = aggregate_phases(records)
+    if not phases:
+        return f"no span records in {', '.join(files)}"
+    lines = [
+        f"trace: {', '.join(files)}",
+        "",
+        format_phase_table(phases),
+    ]
+    snaps = last_counters(records)
+    for pid in sorted(snaps):
+        counters = snaps[pid]
+        flat = dict(counters.get("counters", {}))
+        flat.update(counters.get("gauges", {}))
+        if not flat:
+            continue
+        lines.append("")
+        label = (
+            "counters/gauges (final snapshot):" if len(snaps) == 1
+            else f"counters/gauges (final snapshot, host {pid}):"
+        )
+        lines.append(label)
+        for k in sorted(flat):
+            v = flat[k]
+            shown = f"{v:.6g}" if isinstance(v, float) else str(v)
+            lines.append(f"  {k} = {shown}")
+    return "\n".join(lines)

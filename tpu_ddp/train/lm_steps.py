@@ -20,11 +20,14 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+
+import tpu_ddp.compat  # noqa: F401  (jax.shard_map/typeof shims)
 import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_ddp.compat import GRAD_SYNC_IN_AD
 from tpu_ddp.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
 from tpu_ddp.train.state import TrainState
 
@@ -53,10 +56,14 @@ def make_lm_train_step(
             loss = _token_nll(logits[:, :-1], tokens[:, 1:]).mean()
             # pmean BEFORE differentiation: AD of the averaged loss emits
             # the cross-shard grad psum (the DDP semantics, exactly as in
-            # train/steps.py)
-            return lax.pmean(loss, data_axis)
+            # train/steps.py). SHIMMED jax: sync moves to the explicit
+            # grad pmean below.
+            return lax.pmean(loss, data_axis) if GRAD_SYNC_IN_AD else loss
 
         loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        if not GRAD_SYNC_IN_AD:
+            grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
+            loss = lax.pmean(loss, data_axis)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         return (
@@ -107,9 +114,24 @@ def make_sp_lm_train_step(
             count = lax.psum(mask.sum(), seq_axis)
             # global mean over valid positions == the DP step's mean over
             # (B, T-1); then DDP-average over data
-            return lax.pmean(loss_sum / count, data_axis)
+            loss = loss_sum / count  # already seq-invariant (psum above)
+            if GRAD_SYNC_IN_AD:
+                return lax.pmean(loss, data_axis)
+            # SHIMMED: old jax transposes the loss_sum psum back to a psum,
+            # so the n_seq identical per-shard loss seeds re-sum into an
+            # n_seq over-count of every cotangent; pre-scaling the
+            # differentiated value cancels it (the metric is rescaled below)
+            return loss / n_seq
 
         loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        if not GRAD_SYNC_IN_AD:
+            # each (data, seq) shard's AD yields its local partial of the
+            # replicated params' gradient: sum the partials over the
+            # sequence ring, then DDP-average over data
+            grads = jax.tree.map(
+                lambda g: lax.pmean(lax.psum(g, seq_axis), data_axis), grads
+            )
+            loss = lax.pmean(loss * n_seq, data_axis)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         return (

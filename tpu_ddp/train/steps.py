@@ -33,11 +33,14 @@ import functools
 from typing import Callable, Optional
 
 import jax
+
+import tpu_ddp.compat  # noqa: F401  (jax.shard_map/typeof shims)
 import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from tpu_ddp.compat import GRAD_SYNC_IN_AD
 from tpu_ddp.parallel.mesh import DATA_AXIS
 from tpu_ddp.train.losses import (
     combine_aux_loss,
@@ -45,6 +48,16 @@ from tpu_ddp.train.losses import (
     masked_accuracy,
 )
 from tpu_ddp.train.state import TrainState
+
+# GRAD_SYNC_IN_AD (tpu_ddp.compat): where the DDP gradient sync lives.
+# Modern jax (check_vma shard_map): pmean the per-shard loss BEFORE
+# differentiation — AD's transpose of the replicated-params pbroadcast IS
+# the cross-shard psum, and XLA overlaps it with the backward pass. Old
+# jax (SHIMMED): that rep machinery cannot trace grad-of-pmean, so the
+# builders differentiate the LOCAL loss and pmean the gradients
+# explicitly — identical math (pmean is linear, so pmean-of-grads ==
+# grad-of-pmean'd-loss), just without the automatic backward/comm
+# interleaving.
 
 
 def resolve_remat(model, remat: bool):
@@ -110,16 +123,19 @@ def _make_shard_step(
                     + (1.0 - batch["_mix_lam"])
                     * loss_fn(logits, batch["_mix_label"], batch.get("mask")))
         loss, aux = combine_aux_loss(task, mutated, aux_weight)
-        # Gradient sync lives HERE: pmean-ing the per-shard loss before
-        # differentiation makes reverse-mode AD produce the globally
-        # *averaged* gradient — the pmean's transpose scatters cotangent
-        # 1/num_shards to every shard, and differentiating w.r.t. replicated
-        # (unvarying) params inserts the cross-shard psum automatically under
-        # shard_map. Net effect: grads == grad of the global mean loss, the
-        # exact semantics of DDP's NCCL allreduce-mean (main.py:63), with the
-        # collective visible to XLA for backward/comm overlap. (An explicit
-        # post-hoc pmean on grads would DOUBLE-count: AD has already summed.)
-        loss = lax.pmean(loss, data_axis)
+        # Gradient sync lives HERE on modern jax: pmean-ing the per-shard
+        # loss before differentiation makes reverse-mode AD produce the
+        # globally *averaged* gradient — the pmean's transpose scatters
+        # cotangent 1/num_shards to every shard, and differentiating w.r.t.
+        # replicated (unvarying) params inserts the cross-shard psum
+        # automatically under shard_map. Net effect: grads == grad of the
+        # global mean loss, the exact semantics of DDP's NCCL allreduce-mean
+        # (main.py:63), with the collective visible to XLA for backward/comm
+        # overlap. (An explicit post-hoc pmean on grads would then DOUBLE-
+        # count: AD has already summed.) On SHIMMED jax the sync is instead
+        # the explicit grad pmean in shard_step — see GRAD_SYNC_IN_AD.
+        if GRAD_SYNC_IN_AD:
+            loss = lax.pmean(loss, data_axis)
         return loss, (mutated.get("batch_stats", batch_stats), logits, task, aux)
 
     def shard_step(state: TrainState, batch: Batch):
@@ -141,12 +157,20 @@ def _make_shard_step(
             batch = dict(batch, image=mixed,
                          _mix_label=batch["label"][perm], _mix_lam=lam)
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
-        (_, (new_stats, logits, task, aux)), grads = grad_fn(
-            state.params, state.batch_stats, batch
-        )
+        # named scopes label the XLA ops so a jax.profiler device trace
+        # (and the telemetry Chrome trace next to it) read the same phases
+        with jax.named_scope("tpu_ddp.forward_backward"):
+            (_, (new_stats, logits, task, aux)), grads = grad_fn(
+                state.params, state.batch_stats, batch
+            )
+        if not GRAD_SYNC_IN_AD:
+            grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
         new_stats = jax.tree.map(lambda s: lax.pmean(s, data_axis), new_stats)
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        with jax.named_scope("tpu_ddp.optimizer_update"):
+            updates, new_opt_state = tx.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
@@ -323,7 +347,8 @@ def make_grad_accum_train_step(
         logits, mutated = apply_model(params, batch_stats, micro["image"])
         task = loss_fn(logits, micro["label"], micro.get("mask"))
         loss, aux = combine_aux_loss(task, mutated, aux_weight)
-        loss = lax.pmean(loss, data_axis)  # grad sync, as in _make_shard_step
+        if GRAD_SYNC_IN_AD:  # grad sync, as in _make_shard_step
+            loss = lax.pmean(loss, data_axis)
         return loss, (mutated.get("batch_stats", batch_stats), logits, task, aux)
 
     def shard_step(state: TrainState, batch: Batch):
@@ -369,6 +394,8 @@ def make_grad_accum_train_step(
             micros,
         )
         grads = jax.tree.map(lambda g: g / accum_steps, grads_acc)
+        if not GRAD_SYNC_IN_AD:  # see _make_shard_step: explicit sync
+            grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
         new_stats = jax.tree.map(lambda s: lax.pmean(s, data_axis), new_stats)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

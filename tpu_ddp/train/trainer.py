@@ -122,6 +122,18 @@ class TrainConfig:
     profile_dir: Optional[str] = None     # emit an XLA/TPU trace (Tensor-
                                           # Board/Perfetto) for ONE steady-
                                           # state epoch (SURVEY.md §5.1)
+    telemetry_dir: Optional[str] = None   # run dir for the structured
+                                          # telemetry sinks (per-host JSONL
+                                          # + Chrome trace + heartbeats);
+                                          # None = telemetry disabled.
+                                          # NOTE: per-step phase spans add
+                                          # a block_until_ready fence per
+                                          # step — attribution costs the
+                                          # async-dispatch overlap
+    telemetry_sinks: str = "jsonl,chrome,summary"  # comma-separated subset
+    watchdog_deadline_seconds: float = 0.0  # >0: hang watchdog — stack
+                                          # dump + heartbeat staleness when
+                                          # no step completes in time
     freeze_prefixes: Optional[tuple] = None  # e.g. ("fc",) trains head only
     loss: str = "ce"                      # "ce" | "bce" (multi-label,
                                           # ppe_main_ddp.py:147)
@@ -247,6 +259,21 @@ class Trainer:
 
             assert_process_contiguous_data_axis(self.mesh, self.process_count)
 
+        # Telemetry first: the loaders and checkpointer it is passed to are
+        # built below. Disabled (NULL) unless --telemetry-dir is given.
+        from tpu_ddp.telemetry import build_telemetry
+
+        self.telemetry = build_telemetry(
+            config.telemetry_dir,
+            config.telemetry_sinks,
+            process_index=self.process_index,
+        )
+        self._watchdog = None
+        if config.profile_dir:
+            # satellite fix: create the profiler dir up front — a typo'd
+            # path fails NOW, not after an epoch of training
+            os.makedirs(config.profile_dir, exist_ok=True)
+
         self.model = build_model(config)
         self._load_data(train_data, test_data)
         total_steps = self.train_loader.steps_per_epoch * config.epochs
@@ -315,10 +342,14 @@ class Trainer:
         if config.checkpoint_dir:
             from tpu_ddp.checkpoint import Checkpointer
 
-            self.checkpointer = Checkpointer(config.checkpoint_dir)
+            self.checkpointer = Checkpointer(
+                config.checkpoint_dir, telemetry=self.telemetry
+            )
             if config.keep_best:
                 best_dir = os.path.join(config.checkpoint_dir, "best")
-                self.best_checkpointer = Checkpointer(best_dir, max_to_keep=1)
+                self.best_checkpointer = Checkpointer(
+                    best_dir, max_to_keep=1, telemetry=self.telemetry
+                )
                 meta = os.path.join(best_dir, "metadata.json")
                 if config.resume and os.path.isfile(meta):
                     # don't demote a resumed run's best on the first eval;
@@ -508,6 +539,7 @@ class Trainer:
             seed=c.seed,
             process_index=self.process_index,
             process_count=self.process_count,
+            telemetry=self.telemetry,
         )
         if c.loss == "bce" and np.asarray(train[1]).ndim != 2:
             raise ValueError(
@@ -523,6 +555,7 @@ class Trainer:
             exclude_sampler_pad=True,  # metrics count each sample once
             process_index=self.process_index,
             process_count=self.process_count,
+            telemetry=self.telemetry,
         )
 
     def _put(self, batch):
@@ -573,25 +606,38 @@ class Trainer:
                 )
             yield from self._prefetched_stream(K, depth)
             return
+        tel = self.telemetry
         if K <= 1:
-            for batch in self.train_loader:
-                yield "single", self._put(batch), int(batch["mask"].sum())
-            return
+            it = iter(self.train_loader)
+            while True:
+                with tel.span("data_wait"):
+                    batch = next(it, None)
+                if batch is None:
+                    return
+                with tel.span("h2d"):
+                    dev = self._put(batch)
+                yield "single", dev, int(batch["mask"].sum())
         pending = []
-        for batch in self.train_loader:
+        it = iter(self.train_loader)
+        while True:
+            with tel.span("data_wait"):
+                batch = next(it, None)
+            if batch is None:
+                break
             pending.append(batch)
             if len(pending) == K:
-                stacked = {
-                    k: np.stack([b[k] for b in pending]) for k in pending[0]
-                }
-                yield (
-                    "stacked",
-                    self._put_with(stacked, self.stacked_sharding),
-                    int(stacked["mask"].sum()),
-                )
+                with tel.span("h2d"):
+                    stacked = {
+                        k: np.stack([b[k] for b in pending])
+                        for k in pending[0]
+                    }
+                    dev = self._put_with(stacked, self.stacked_sharding)
+                yield "stacked", dev, int(stacked["mask"].sum())
                 pending = []
         for batch in pending:
-            yield "single", self._put(batch), int(batch["mask"].sum())
+            with tel.span("h2d"):
+                dev = self._put(batch)
+            yield "single", dev, int(batch["mask"].sum())
 
     def _prefetched_stream(self, K: int, depth: int):
         """Prefetcher-backed _epoch_stream body. A fused K-step group is ONE
@@ -647,23 +693,28 @@ class Trainer:
 
         in_flight = deque()
 
+        tel = self.telemetry
+
         def emit():
             kind, mask = in_flight.popleft()
-            img, lbl, slot = pf.acquire()  # FIFO: matches oldest submission
-            if host_copy:
-                img, lbl = np.copy(img), np.copy(lbl)
-            if kind == "stacked":
-                img = img.reshape((K, -1) + img_tail)
-                lbl = lbl.reshape((K, -1) + lbl_tail)
-                sharding = self.stacked_sharding
-            else:
-                sharding = self.batch_sharding
-            dev = self._put_with(
-                {"image": img, "label": lbl, "mask": mask}, sharding
-            )
-            # Fence ONLY the H2D transfer, then recycle the slot; the copy
-            # of batch N+depth overlaps the device computing batch N.
-            jax.block_until_ready(dev)
+            with tel.span("data_wait"):
+                # blocks until the prefetcher finishes the oldest gather
+                img, lbl, slot = pf.acquire()  # FIFO: matches oldest submission
+            with tel.span("h2d"):
+                if host_copy:
+                    img, lbl = np.copy(img), np.copy(lbl)
+                if kind == "stacked":
+                    img = img.reshape((K, -1) + img_tail)
+                    lbl = lbl.reshape((K, -1) + lbl_tail)
+                    sharding = self.stacked_sharding
+                else:
+                    sharding = self.batch_sharding
+                dev = self._put_with(
+                    {"image": img, "label": lbl, "mask": mask}, sharding
+                )
+                # Fence ONLY the H2D transfer, then recycle the slot; the
+                # copy of batch N+depth overlaps the device computing batch N.
+                jax.block_until_ready(dev)
             pf.release(slot)
             return kind, dev, int(mask.sum())
 
@@ -676,10 +727,16 @@ class Trainer:
             yield emit()
 
     def close(self) -> None:
-        """Release the host prefetcher (worker thread + slot buffers)."""
+        """Release the host prefetcher (worker thread + slot buffers), stop
+        the watchdog, and finalize the telemetry sinks (writes the Chrome
+        trace, prints the phase summary). Idempotent."""
         if self._prefetcher is not None:
             self._prefetcher.close()
             self._prefetcher = None
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+        self.telemetry.close()
 
     def run(self) -> dict:
         try:
@@ -750,8 +807,18 @@ class Trainer:
         # number — the headline metric — is then correct on any pod size,
         # and the aggregate is scaled back up below (symmetric hosts).
         n_local_chips = self.world_size // self.process_count
-        throughput = Throughput(n_chips=n_local_chips)
+        tel = self.telemetry
+        throughput = Throughput(n_chips=n_local_chips, registry=tel.registry)
         throughput.start()
+        if c.watchdog_deadline_seconds > 0:
+            from tpu_ddp.telemetry import HangWatchdog
+
+            self._watchdog = HangWatchdog(
+                c.watchdog_deadline_seconds,
+                heartbeat_dir=c.telemetry_dir,
+                process_index=self.process_index,
+                telemetry=tel,
+            ).start()
         last_metrics = {}
         # Steady-state step time: measured per epoch between REAL sync points
         # (the device_get below), excluding the first epoch (XLA compile).
@@ -794,6 +861,12 @@ class Trainer:
             step_losses = []
             epoch_metrics = None
             n_steps = 0
+            # host-side global step mirror (one device sync per epoch),
+            # kept for BOTH consumers so watchdog heartbeats/hang logs
+            # carry the global step even with telemetry off
+            track_step = tel.enabled or self._watchdog is not None
+            host_step = int(self.state.step) if track_step else 0
+            tel.current_step = host_step
             skip = resume_skip if epoch == start_epoch + 1 else 0
             for kind, dev_batch, n_real in self._epoch_stream():
                 # Drain at batch boundaries only when single-host: on a pod
@@ -810,17 +883,41 @@ class Trainer:
                         continue
                     skip = 0  # straddling fused group: replay its tail
                 if kind == "stacked":
-                    self.state, epoch_metrics = self.multi_step(
-                        self.state, dev_batch
-                    )
+                    with tel.span("compiled_step", steps=self.steps_per_call):
+                        self.state, epoch_metrics = self.multi_step(
+                            self.state, dev_batch
+                        )
                     step_losses.append(epoch_metrics["loss"])  # (K,)
                     n_steps += self.steps_per_call
                 else:
-                    self.state, epoch_metrics = self.train_step(
-                        self.state, dev_batch
-                    )
+                    with tel.span("compiled_step"):
+                        self.state, epoch_metrics = self.train_step(
+                            self.state, dev_batch
+                        )
                     step_losses.append(epoch_metrics["loss"])
                     n_steps += 1
+                if track_step:
+                    host_step += (
+                        self.steps_per_call if kind == "stacked" else 1
+                    )
+                if tel.enabled:
+                    # Attribution needs a per-step fence: "compiled_step"
+                    # above is the async dispatch, "device_sync" is the
+                    # device finishing the step. This is the one deliberate
+                    # deviation from the fence-free hot loop — tracing IS
+                    # the request to measure it (config docstring).
+                    with tel.span("device_sync"):
+                        jax.block_until_ready(epoch_metrics["loss"])
+                    tel.current_step = host_step
+                    dn = self.steps_per_call if kind == "stacked" else 1
+                    tel.count("train/steps", dn)
+                    tel.count("train/images", n_real)
+                if self._watchdog is not None:
+                    # without tracing the dispatch is async: the beat then
+                    # means "the host is still submitting work", which
+                    # still catches wedged collectives (the host blocks
+                    # inside the NEXT dispatch when the device queue jams)
+                    self._watchdog.beat(host_step)
                 if mfu_probe is None:
                     mfu_probe = (kind, dev_batch)
                 throughput.add(n_real)
@@ -837,17 +934,19 @@ class Trainer:
                         self.logger.log_text(
                             f"Epoch {epoch}, iter {n_steps}, loss {cur:.4f}"
                         )
-            mean_loss = (
-                float(
-                    np.mean(
-                        np.concatenate(
-                            [np.atleast_1d(x) for x in jax.device_get(step_losses)]
+            with tel.span("epoch_metrics_fetch", epoch=epoch):
+                mean_loss = (
+                    float(
+                        np.mean(
+                            np.concatenate(
+                                [np.atleast_1d(x)
+                                 for x in jax.device_get(step_losses)]
+                            )
                         )
                     )
+                    if step_losses
+                    else float("nan")
                 )
-                if step_losses
-                else float("nan")
-            )
             trace_dump_seconds = 0.0
             if epoch == profile_epoch:
                 # the device_get above already fenced the epoch's dispatches;
@@ -858,7 +957,18 @@ class Trainer:
                 # the trace dump is host IO, not training — keep it out of
                 # the steady-state throughput window below
                 trace_dump_seconds = time.perf_counter() - trace_t0
-                self.logger.log_text(f"profiler trace -> {c.profile_dir}")
+                # satellite fix: the trace location goes through the
+                # telemetry sinks (a machine-readable instant event); the
+                # text line remains only as the no-telemetry fallback
+                if tel.enabled:
+                    tel.instant(
+                        "profiler_trace_written",
+                        path=os.path.abspath(c.profile_dir),
+                        epoch=epoch,
+                        dump_seconds=round(trace_dump_seconds, 3),
+                    )
+                else:
+                    self.logger.log_text(f"profiler trace -> {c.profile_dir}")
             if self._preempt_agreed():
                 self.logger.log_text(
                     f"preempted at step {int(self.state.step)} "
@@ -900,7 +1010,8 @@ class Trainer:
                 if self.checkpointer and epoch % c.checkpoint_every_epochs in (0, 1):
                     self.checkpointer.save(int(self.state.step), self.state)
             if c.eval_each_epoch:
-                acc, loss = self.evaluate()
+                with tel.span("eval", epoch=epoch):
+                    acc, loss = self.evaluate()
                 self.history.setdefault("test_loss", []).append(loss)
                 if c.loss == "ce":  # accuracy undefined for multi-hot targets
                     self.logger.log(
@@ -932,6 +1043,21 @@ class Trainer:
                             os.replace(tmp, meta)
                 else:
                     self.logger.log(int(self.state.step), test_loss=loss)
+            if tel.enabled:
+                # epoch boundary: refresh derived gauges and snapshot the
+                # registry into the sinks (Chrome "C" series + JSONL record)
+                from tpu_ddp.metrics.memory import record_memory_gauges
+
+                epoch_seconds = time.perf_counter() - epoch_t0
+                if epoch_seconds > 0 and n_steps:
+                    tel.gauge("train/steps_per_sec").set(
+                        n_steps / epoch_seconds
+                    )
+                    tel.gauge("train/images_per_sec_per_chip").set(
+                        throughput.images_per_sec_per_chip
+                    )
+                record_memory_gauges(tel.registry)
+                tel.emit_counters()
         throughput.stop(wait_for=self.state.params)
         total = time.time() - start
         # reference wall-clock line: main.py:49
@@ -959,6 +1085,14 @@ class Trainer:
             images_per_sec_per_chip=throughput.images_per_sec_per_chip,
             mfu=self._compute_mfu(mfu_probe, steady_steps, steady_seconds),
         )
+        if tel.enabled:
+            from tpu_ddp.metrics.mfu import record_mfu
+
+            tel.gauge("train/images_per_sec_per_chip").set(
+                throughput.images_per_sec_per_chip
+            )
+            record_mfu(tel.registry, last_metrics.get("mfu"))
+            # final snapshot lands via tel.close() in Trainer.close()
         return last_metrics
 
     def _compute_mfu(self, mfu_probe, steady_steps, steady_seconds):
