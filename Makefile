@@ -3,7 +3,8 @@
 
 PYTHON ?= python
 
-.PHONY: test test-all dryrun bench smoke capture aot real-data lint trace-demo
+.PHONY: test test-all dryrun bench smoke capture aot real-data lint \
+	trace-demo health-demo
 
 # Fast default loop (round-3 verdict item 5): skips the `slow`-marked
 # multi-process / end-to-end-CLI / AOT tests. CI and pre-commit should run
@@ -67,6 +68,19 @@ trace-demo:
 	  --telemetry-dir $(TRACE_DEMO_DIR) --watchdog-deadline 300
 	JAX_PLATFORMS=cpu $(PYTHON) -m tpu_ddp.cli.main trace summarize \
 	  $(TRACE_DEMO_DIR)
+
+# Numerics flight-recorder acceptance: a short CPU run with one injected
+# all-NaN batch under --health on / --health-policy skip_step. The demo
+# exits non-zero unless the NaN step was detected, the anomaly dump
+# (stats + history + offending batch) was written, the poisoned update
+# was discarded, and training recovered with finite params — then the
+# run dir renders through `tpu-ddp health`.
+HEALTH_DEMO_DIR ?= /tmp/tpu_ddp_health_demo
+health-demo:
+	rm -rf $(HEALTH_DEMO_DIR)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  $(PYTHON) -m tpu_ddp.tools.health_demo --dir $(HEALTH_DEMO_DIR)
+	$(PYTHON) -m tpu_ddp.cli.main health $(HEALTH_DEMO_DIR)
 
 # 2-epoch end-to-end CLI run on the virtual mesh (fast sanity check).
 smoke:

@@ -7,10 +7,14 @@ Subcommands:
 - ``tpu-ddp trace summarize <run_dir>`` — aggregate a telemetry JSONL
   trace into per-phase percentiles (p50/p95/max) and the final
   counters/gauges snapshot.
+- ``tpu-ddp health <run_dir>`` — render a monitored run's numerics
+  timeline (loss/grad-norm percentiles + sparkline, non-finite and
+  loss-spike steps) and any anomaly dumps (docs/health.md).
 
-``trace summarize`` is stdlib-only end to end (no jax import): traces are
-summarized wherever they land — a laptop, a CI box, the pod host itself.
-The train/launch subcommands import lazily so `trace` keeps that property.
+``trace summarize`` and ``health`` are stdlib-only end to end (no jax
+import): records are summarized wherever they land — a laptop, a CI box,
+the pod host itself. The train/launch subcommands import lazily so the
+read-back commands keep that property.
 """
 
 from __future__ import annotations
@@ -27,6 +31,17 @@ def _trace_summarize(args) -> int:
         print(summarize(args.path))
     except (FileNotFoundError, ValueError) as e:
         print(f"tpu-ddp trace summarize: {e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+def _health_summarize(args) -> int:
+    from tpu_ddp.health.summarize import summarize_health
+
+    try:
+        print(summarize_health(args.path))
+    except (FileNotFoundError, ValueError) as e:
+        print(f"tpu-ddp health: {e}", file=sys.stderr)
         return 2
     return 0
 
@@ -62,6 +77,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     summ.add_argument("path", help="run dir (holding trace-p*.jsonl) or a "
                                    "trace file")
     summ.set_defaults(func=_trace_summarize)
+    health = sub.add_parser(
+        "health",
+        help="numerics timeline + anomalies from a run dir's health "
+             "record (see --health on tpu-ddp train)",
+    )
+    health.add_argument("path", help="run dir (holding health-p*.jsonl) "
+                                     "or a health file")
+    health.set_defaults(func=_health_summarize)
     args = ap.parse_args(argv)
     return args.func(args)
 

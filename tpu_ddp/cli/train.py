@@ -179,6 +179,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "and dumps all thread stacks when no step "
                         "completes within the deadline (multihost wedge "
                         "forensics)")
+    p.add_argument("--health", choices=["off", "on"], default="off",
+                   help="numerics flight recorder: global grad/param/"
+                        "update norms + NaN/Inf sentinels computed INSIDE "
+                        "the compiled step every step, recorded to "
+                        "health-p<host>.jsonl (under --health-dir / "
+                        "--telemetry-dir), with a loss-spike detector and "
+                        "a one-shot anomaly dump to <dir>/anomalies/. "
+                        "Read back with `tpu-ddp health DIR`")
+    p.add_argument("--health-policy",
+                   choices=["warn", "skip_step", "halt"], default="warn",
+                   help="on an anomaly: warn (log + dump), skip_step "
+                        "(an in-graph guard discards NaN/Inf updates — "
+                        "optimizer state stays in sync, training "
+                        "continues; loss spikes are recorded but still "
+                        "applied), halt (drain + final checkpoint on any "
+                        "anomaly)")
+    p.add_argument("--health-per-layer-stride", type=int, default=0,
+                   metavar="N",
+                   help=">0: also compute the per-layer grad/param norm "
+                        "breakdown in-graph, recording it every N steps "
+                        "(and always into anomaly dumps)")
+    p.add_argument("--health-dir", default=None, metavar="DIR",
+                   help="where health records + anomaly dumps go "
+                        "(default: --telemetry-dir)")
+    p.add_argument("--health-window", type=int, default=128,
+                   help="loss-spike detector rolling window (steps)")
+    p.add_argument("--health-spike-threshold", type=float, default=10.0,
+                   metavar="K",
+                   help="spike when loss > median + K * MAD of the window")
     p.add_argument("--compilation-cache-dir", default=None, metavar="DIR",
                    help="persistent XLA compilation cache: repeat runs skip "
                         "the 20-40s first-compile (cache is keyed on "
@@ -334,6 +363,12 @@ def config_from_args(args) -> TrainConfig:
         telemetry_dir=args.telemetry_dir,
         telemetry_sinks=args.telemetry_sinks,
         watchdog_deadline_seconds=args.watchdog_deadline,
+        health=args.health,
+        health_policy=args.health_policy,
+        health_per_layer_stride=args.health_per_layer_stride,
+        health_dir=args.health_dir,
+        health_window=args.health_window,
+        health_spike_threshold=args.health_spike_threshold,
         freeze_prefixes=tuple(args.freeze) if args.freeze else None,
         loss=args.loss,
         label_smoothing=args.label_smoothing,
@@ -346,7 +381,7 @@ def config_from_args(args) -> TrainConfig:
         steps_per_call=args.steps_per_call,
         grad_accum_steps=args.grad_accum_steps,
         prefetch_depth=args.prefetch_depth,
-    )
+    ).validate()  # satellite: bad sink/policy names fail at parse time
 
 
 def run_cv(args, config) -> dict:
@@ -367,8 +402,22 @@ def run_cv(args, config) -> dict:
     )
 
     def make_trainer(train_data, val_data, fold):
+        import os
+
         print(f"[cv] fold {fold + 1}/{args.cv_mode}")
-        return Trainer(fold_config, train_data=train_data, test_data=val_data)
+        # telemetry/health sinks open their files with mode "w": sharing
+        # one run dir across folds would leave only the LAST fold's
+        # records — give each fold a subdirectory instead
+        cfg = dataclasses.replace(
+            fold_config,
+            telemetry_dir=(
+                os.path.join(fold_config.telemetry_dir, f"fold{fold}")
+                if fold_config.telemetry_dir else None),
+            health_dir=(
+                os.path.join(fold_config.health_dir, f"fold{fold}")
+                if fold_config.health_dir else None),
+        )
+        return Trainer(cfg, train_data=train_data, test_data=val_data)
 
     results = run_kfold(
         np.asarray(images), np.asarray(labels),
@@ -415,6 +464,16 @@ def main(argv=None) -> dict:
             "or --pretrained-dir ..."
         )
     trainer = Trainer(config)
+    try:
+        return _run_and_report(args, config, trainer)
+    finally:
+        # telemetry sinks close HERE (not inside run()): the final-eval
+        # gauges recorded below must land in the final counters snapshot,
+        # so the JSONL trace is a self-contained run record
+        trainer.close()
+
+
+def _run_and_report(args, config, trainer) -> dict:
     if args.eval_only and config.resume and trainer.resumed_step is None:
         # the one mode whose entire purpose is loading weights must not
         # silently evaluate random init when the checkpoint dir is empty
@@ -422,7 +481,10 @@ def main(argv=None) -> dict:
             f"--eval-only: no checkpoint found under "
             f"{config.checkpoint_dir!r} to resume from"
         )
-    metrics = {"eval_only": True} if args.eval_only else trainer.run()
+    metrics = (
+        {"eval_only": True} if args.eval_only
+        else trainer.run(close=False)
+    )
     if metrics.get("preempted"):
         # Drained on a preemption signal: the checkpoint is written; every
         # second of post-run work (eval compile, prediction dumps) eats
@@ -442,8 +504,10 @@ def main(argv=None) -> dict:
             f"final test accuracy: {acc:.4f}, test loss: {loss:.4f}"
         )
         metrics["test_accuracy"] = acc
+        trainer.record_final_eval(accuracy=acc, loss=loss)
     else:  # accuracy is undefined for multi-hot targets; mAP covers it
         trainer.logger.log_text(f"final test loss: {loss:.4f}")
+        trainer.record_final_eval(loss=loss)
     if args.dump_predictions or args.viz_predictions:
         import numpy as np
 

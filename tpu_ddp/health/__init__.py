@@ -1,0 +1,56 @@
+"""Numerics flight recorder: in-graph model-health stats, NaN/Inf
+sentinels, and anomaly-triggered diagnostics.
+
+Three layers (see ``docs/health.md``):
+
+- ``stats``      — the in-graph half: global/per-layer norms, update
+  ratio, and finite-ness sentinels computed INSIDE the compiled train
+  step (extra metric leaves; zero additional dispatches), plus the
+  skip-step guard that discards a non-finite update without desyncing
+  optimizer state. Imports jax.
+- ``monitor``    — the host half: per-step JSONL record, telemetry
+  gauges/counters, the rolling median+MAD loss-spike detector, and the
+  one-shot anomaly dump (``run_dir/anomalies/step_<n>/``) with the
+  offending batch and recent history. numpy + stdlib.
+- ``summarize``  — the read-back half behind ``tpu-ddp health
+  <run_dir>``. Stdlib-only (no jax, no numpy), like the trace
+  summarizer, so health records render anywhere they land.
+
+Exports are lazy so the CLI path (`summarize`) never pulls in jax.
+"""
+
+from tpu_ddp.health.summarize import (  # noqa: F401  (stdlib-only)
+    HEALTH_SCHEMA_VERSION,
+    summarize_health,
+)
+
+_LAZY = {
+    "HealthConfig": "tpu_ddp.health.stats",
+    "HEALTH_SCALAR_KEYS": "tpu_ddp.health.stats",
+    "health_stats": "tpu_ddp.health.stats",
+    "assemble_stats": "tpu_ddp.health.stats",
+    "tree_sq": "tpu_ddp.health.stats",
+    "tree_nonfinite": "tpu_ddp.health.stats",
+    "per_layer_sq": "tpu_ddp.health.stats",
+    "tree_select": "tpu_ddp.health.stats",
+    "guard_step": "tpu_ddp.health.stats",
+    "HealthMonitor": "tpu_ddp.health.monitor",
+    "SpikeDetector": "tpu_ddp.health.monitor",
+    "POLICIES": "tpu_ddp.health.monitor",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+
+__all__ = [
+    "HEALTH_SCHEMA_VERSION",
+    "summarize_health",
+    *sorted(_LAZY),
+]

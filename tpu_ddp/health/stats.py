@@ -1,0 +1,200 @@
+"""In-graph numerics health stats — the device half of the flight recorder.
+
+Everything here is called INSIDE the compiled train step (under
+``jax.shard_map`` or a GSPMD ``jit``): the stats come back as extra leaves
+of the step's metrics dict, so enabling health costs zero additional
+dispatches and the tensors never round-trip to host for the computation
+itself. Per the sharded-weight-update literature (PAPERS.md: cross-replica
+sharding), norms are reduced where the values live — the step builders
+hand this module *already-synchronized* gradients/updates (or pre-reduce
+the sharded pieces, see ``parallel/pipeline.py``), so every shard reports
+the identical global number.
+
+Schema (``metrics["health"]``), shared by every parallelism family:
+
+- ``loss``           — the step's synchronized scalar loss (f32)
+- ``grad_norm``      — global L2 norm of the synced gradient
+- ``param_norm``     — global L2 norm of the parameters
+- ``update_norm``    — global L2 norm of the optax update actually applied
+- ``update_ratio``   — update_norm / param_norm (the "how hard did this
+  step move the model" scale-free signal)
+- ``loss_finite`` / ``grads_finite`` / ``updates_finite`` — bool sentinels
+- ``all_finite``     — conjunction of the three (the skip-step gate)
+- ``per_layer``      — optional {"grad_norm"|"param_norm": {path: norm}}
+  breakdown (compiled in when the per-layer stride is enabled)
+
+Finite-ness is established by COUNTING non-finite elements, not by
+inspecting the norms: a norm can overflow to inf from large-but-finite
+values, which must read as "exploding", never as "NaN'd".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+#: Keys every step builder's ``metrics["health"]`` carries (the scalar
+#: schema; ``per_layer`` is additionally present at a per-layer stride).
+HEALTH_SCALAR_KEYS = (
+    "loss",
+    "grad_norm",
+    "param_norm",
+    "update_norm",
+    "update_ratio",
+    "loss_finite",
+    "grads_finite",
+    "updates_finite",
+    "all_finite",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthConfig:
+    """Static (trace-time) configuration a step builder compiles in.
+
+    ``per_layer`` adds the per-layer norm breakdown to the metrics (the
+    host decides how often to *record* it — the stride — but the compute
+    is in-graph either way, a handful of reductions per parameter).
+    ``skip_nonfinite`` compiles the skip-step guard: a non-finite
+    loss/grad/update selects the OLD params, batch_stats and optimizer
+    state, so the poisoned update is discarded without desyncing anything
+    (``state.step`` still advances — the batch was consumed)."""
+
+    per_layer: bool = False
+    skip_nonfinite: bool = False
+
+
+def _f32(x):
+    return jnp.asarray(x, jnp.float32)
+
+
+def tree_sq(tree) -> jnp.ndarray:
+    """Sum of squares over every leaf (f32 accumulation)."""
+    leaves = jax.tree.leaves(tree)
+    total = jnp.zeros((), jnp.float32)
+    for leaf in leaves:
+        total = total + jnp.sum(jnp.square(_f32(leaf)))
+    return total
+
+
+def tree_nonfinite(tree) -> jnp.ndarray:
+    """Count of non-finite elements over every leaf (f32 scalar)."""
+    leaves = jax.tree.leaves(tree)
+    total = jnp.zeros((), jnp.float32)
+    for leaf in leaves:
+        total = total + jnp.sum((~jnp.isfinite(_f32(leaf))).astype(jnp.float32))
+    return total
+
+
+def path_name(path) -> str:
+    """A jax key-path -> "block_0/conv1/kernel"-style layer name."""
+    parts = []
+    for p in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(p, attr):
+                parts.append(str(getattr(p, attr)))
+                break
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def per_layer_sq(tree) -> Dict[str, jnp.ndarray]:
+    """{layer path: sum of squares} — one scalar per leaf."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return {
+        path_name(path): jnp.sum(jnp.square(_f32(leaf)))
+        for path, leaf in flat
+    }
+
+
+def assemble_stats(
+    *,
+    loss,
+    grad_sq,
+    grad_bad,
+    param_sq,
+    update_sq,
+    update_bad,
+    per_layer: Optional[dict] = None,
+) -> Dict[str, Any]:
+    """Build the schema dict from pre-reduced scalars. Step builders whose
+    gradients are physically sharded (pipeline stages) reduce the pieces
+    with the right collective first and feed the totals here, so the
+    schema — and the host-side consumer — never branches on layout."""
+    loss = _f32(loss)
+    param_norm = jnp.sqrt(param_sq)
+    update_norm = jnp.sqrt(update_sq)
+    loss_finite = jnp.isfinite(loss)
+    grads_finite = grad_bad == 0
+    updates_finite = update_bad == 0
+    stats: Dict[str, Any] = {
+        "loss": loss,
+        "grad_norm": jnp.sqrt(grad_sq),
+        "param_norm": param_norm,
+        "update_norm": update_norm,
+        "update_ratio": update_norm / jnp.maximum(param_norm, 1e-12),
+        "loss_finite": loss_finite,
+        "grads_finite": grads_finite,
+        "updates_finite": updates_finite,
+        "all_finite": loss_finite & grads_finite & updates_finite,
+    }
+    if per_layer is not None:
+        stats["per_layer"] = per_layer
+    return stats
+
+
+def health_stats(
+    *, loss, grads, params, updates, per_layer: bool = False
+) -> Dict[str, Any]:
+    """The standard (replicated / GSPMD-global trees) stats computation.
+
+    Callers guarantee ``grads``/``updates`` are the synchronized values
+    the optimizer consumed, and ``loss`` the synchronized scalar — then
+    every device computes (and reports) the same global stats."""
+    pl = None
+    if per_layer:
+        pl = {
+            "grad_norm": {
+                k: jnp.sqrt(v) for k, v in per_layer_sq(grads).items()
+            },
+            "param_norm": {
+                k: jnp.sqrt(v) for k, v in per_layer_sq(params).items()
+            },
+        }
+    return assemble_stats(
+        loss=loss,
+        grad_sq=tree_sq(grads),
+        grad_bad=tree_nonfinite(grads),
+        param_sq=tree_sq(params),
+        update_sq=tree_sq(updates),
+        update_bad=tree_nonfinite(updates),
+        per_layer=pl,
+    )
+
+
+def tree_select(ok, new_tree, old_tree):
+    """Leaf-wise ``where(ok, new, old)`` — the skip-step guard. ``ok`` is a
+    traced scalar bool, so both branches exist in the graph and the select
+    is a cheap elementwise op the compiler fuses into the update."""
+    return jax.tree.map(
+        lambda n, o: jnp.where(ok, n, o), new_tree, old_tree
+    )
+
+
+def guard_step(health: HealthConfig, hstats, new: tuple, old: tuple) -> tuple:
+    """THE skip-step guard, shared by every step builder: when compiled in
+    (``skip_nonfinite``) and the step's sentinels tripped, each tree in
+    ``new`` is replaced by its counterpart in ``old`` — params, optimizer
+    state, BN stats, whatever the builder carries — so a poisoned update
+    is discarded wholesale and nothing can desync. Identity otherwise.
+
+    ``new``/``old``: equal-length tuples of pytrees (pass empty trees for
+    slots a state variant doesn't have)."""
+    if not health.skip_nonfinite:
+        return new
+    ok = hstats["all_finite"]
+    return tuple(tree_select(ok, n, o) for n, o in zip(new, old))

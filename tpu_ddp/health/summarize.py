@@ -1,0 +1,164 @@
+"""Render a run dir's numerics-health record for ``tpu-ddp health``.
+
+Reads the ``health-p*.jsonl`` files a monitored run wrote (plus the
+``anomalies/`` dump directory) and renders the health timeline: per-metric
+percentiles, a loss/grad-norm sparkline over steps, and every recorded
+anomaly with its dump location. Stdlib-only end to end (same contract as
+``tpu-ddp trace summarize``, whose record-reading loop and percentile
+machinery this reuses): health records are summarized wherever they land —
+no jax, no numpy.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+from typing import Dict, Iterable, List, Optional
+
+#: Version of the health-record JSONL schema (independent of the telemetry
+#: trace schema — the two files evolve separately).
+HEALTH_SCHEMA_VERSION = 1
+
+#: Scalar series the summary table reports, in display order.
+SERIES = ("loss", "grad_norm", "param_norm", "update_norm", "update_ratio")
+
+_BARS = "▁▂▃▄▅▆▇█"
+
+
+def find_health_files(path: str) -> List[str]:
+    """A health JSONL itself, or a run dir holding ``health-p*.jsonl``."""
+    if os.path.isfile(path):
+        return [path]
+    if os.path.isdir(path):
+        hits = sorted(glob.glob(os.path.join(path, "health-p*.jsonl")))
+        if hits:
+            return hits
+    raise FileNotFoundError(
+        f"no health record under {path!r} (expected health-p*.jsonl — "
+        "was the run started with --health on?)"
+    )
+
+
+def read_health_records(paths: Iterable[str]) -> List[dict]:
+    """Parse records, skipping torn lines, refusing future schemas —
+    the trace summarizer's loop, pinned to the health schema version."""
+    from tpu_ddp.telemetry.summarize import read_records
+
+    return read_records(paths, schema_version=HEALTH_SCHEMA_VERSION,
+                        kind="health")
+
+
+def sparkline(values: List[Optional[float]], width: int = 60) -> str:
+    """Bucketed unicode sparkline; non-finite buckets render as ``!``."""
+    if not values:
+        return ""
+    n_buckets = min(width, len(values))
+    per = len(values) / n_buckets
+    out = []
+    finite = [v for v in values if v is not None and math.isfinite(v)]
+    lo = min(finite) if finite else 0.0
+    hi = max(finite) if finite else 1.0
+    span = (hi - lo) or 1.0
+    for b in range(n_buckets):
+        chunk = values[int(b * per):max(int((b + 1) * per), int(b * per) + 1)]
+        good = [v for v in chunk if v is not None and math.isfinite(v)]
+        if len(good) < len(chunk):
+            out.append("!")  # a non-finite step lives in this bucket
+        elif not good:
+            out.append(" ")
+        else:
+            mean = sum(good) / len(good)
+            idx = int((mean - lo) / span * (len(_BARS) - 1))
+            out.append(_BARS[max(0, min(len(_BARS) - 1, idx))])
+    return "".join(out)
+
+
+def list_anomalies(run_dir: str) -> List[dict]:
+    """Read ``anomalies/*/meta.json`` dumps under a run dir."""
+    out = []
+    for meta_path in sorted(
+        glob.glob(os.path.join(run_dir, "anomalies", "*", "meta.json"))
+    ):
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            continue
+        meta["_dir"] = os.path.dirname(meta_path)
+        out.append(meta)
+    return out
+
+
+def summarize_health(path: str) -> str:
+    """Human-readable health timeline for a run dir / health file."""
+    files = find_health_files(path)
+    records = read_health_records(files)
+    steps = [r for r in records if r.get("type") == "health"]
+    lines = [f"health: {', '.join(files)}", ""]
+    if not steps:
+        lines.append("no health step records")
+        return "\n".join(lines)
+    steps.sort(key=lambda r: (r.get("step", 0), r.get("pid", 0)))
+    # one row per step for the timeline: hosts report identical global
+    # stats, so collapse duplicates from multihost run dirs on step id
+    by_step: Dict[int, dict] = {}
+    for r in steps:
+        by_step.setdefault(r.get("step", 0), r)
+    ordered = [by_step[s] for s in sorted(by_step)]
+
+    nonfinite = [r["step"] for r in ordered if not r.get("all_finite", True)]
+    spikes = [r["step"] for r in ordered
+              if r.get("anomaly") == "loss_spike"]
+    lines.append(
+        f"steps: {len(ordered)} "
+        f"(step {ordered[0].get('step')}..{ordered[-1].get('step')})   "
+        f"non-finite: {len(nonfinite)}   loss spikes: {len(spikes)}"
+    )
+    lines.append("")
+
+    from tpu_ddp.telemetry.registry import Histogram  # stdlib-only
+
+    header = (
+        f"{'metric':<14} {'min':>12} {'p50':>12} {'p95':>12} {'max':>12}"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    for key in SERIES:
+        hist = Histogram()
+        for r in ordered:
+            v = r.get(key)
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                hist.record(v)
+        if not hist.count:
+            continue
+        lines.append(
+            f"{key:<14} {hist.min:>12.5g} {hist.percentile(50):>12.5g} "
+            f"{hist.percentile(95):>12.5g} {hist.max:>12.5g}"
+        )
+    lines.append("")
+    for key in ("loss", "grad_norm"):
+        series = [r.get(key) for r in ordered]
+        lines.append(f"{key:<10} |{sparkline(series)}|")
+    if nonfinite:
+        shown = ", ".join(str(s) for s in nonfinite[:10])
+        more = "" if len(nonfinite) <= 10 else f" (+{len(nonfinite) - 10} more)"
+        lines.append("")
+        lines.append(f"non-finite steps: {shown}{more}")
+    if spikes:
+        shown = ", ".join(str(s) for s in spikes[:10])
+        more = "" if len(spikes) <= 10 else f" (+{len(spikes) - 10} more)"
+        lines.append(f"loss-spike steps: {shown}{more}")
+
+    run_dir = path if os.path.isdir(path) else os.path.dirname(path)
+    anomalies = list_anomalies(run_dir) if run_dir else []
+    if anomalies:
+        lines.append("")
+        lines.append("anomaly dumps:")
+        for meta in anomalies:
+            lines.append(
+                f"  step {meta.get('step')}: {meta.get('reason')} "
+                f"(policy {meta.get('policy')}) -> {meta.get('_dir')}"
+            )
+    return "\n".join(lines)

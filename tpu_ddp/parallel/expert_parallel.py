@@ -51,6 +51,7 @@ def make_ep_train_step(
     donate: bool = True,
     remat: bool = False,
     grad_accum_steps: int = 1,
+    health=None,
 ):
     """Expert-parallel (optionally DP x EP) MoE train step.
 
@@ -65,6 +66,6 @@ def make_ep_train_step(
         model, tx, mesh, param_specs,
         data_axis=data_axis, loss_fn=loss_fn, donate=donate,
         aux_weight=aux_weight, remat=remat,
-        grad_accum_steps=grad_accum_steps,
+        grad_accum_steps=grad_accum_steps, health=health,
     )
     return build(state_template)

@@ -27,7 +27,7 @@ Design notes (how the grads stay correct without a hand-written backward):
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 
@@ -40,6 +40,14 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_ddp.compat import GRAD_SYNC_IN_AD
+from tpu_ddp.health.stats import (
+    HealthConfig,
+    assemble_stats,
+    guard_step,
+    per_layer_sq,
+    tree_nonfinite,
+    tree_sq,
+)
 from tpu_ddp.models.vit import TransformerBlock
 from tpu_ddp.parallel.mesh import DATA_AXIS, PIPELINE_AXIS
 from tpu_ddp.train.losses import cross_entropy_loss, masked_accuracy
@@ -106,6 +114,51 @@ def _vit_pieces(model):
     return embed, apply_stage, apply_head
 
 
+def _pp_health_stats(health, *, loss, grads, params, updates, pipe_axis):
+    """Flight-recorder stats for the pipeline layout (same schema as every
+    other step builder — see ``tpu_ddp.health.stats``). The stacked
+    ``blocks`` trees are VARYING over the pipeline axis (each stage holds
+    its own chunk), so their sums-of-squares / non-finite counts are
+    psum'd over the ring before joining the replicated embed/head
+    contributions — every stage then reports the identical global
+    numbers. Per-layer entries for the stacked blocks are reduced the
+    same way and prefixed ``blocks/``."""
+
+    def split(tree):
+        return tree["blocks"], {k: v for k, v in tree.items()
+                                if k != "blocks"}
+
+    def reduced(tree, fn):
+        blocks, rest = split(tree)
+        return lax.psum(fn(blocks), pipe_axis) + fn(rest)
+
+    pl = None
+    if health.per_layer:
+        def layer_norms(tree):
+            blocks, rest = split(tree)
+            out = {
+                "blocks/" + k: jnp.sqrt(lax.psum(v, pipe_axis))
+                for k, v in per_layer_sq(blocks).items()
+            }
+            out.update(
+                {k: jnp.sqrt(v) for k, v in per_layer_sq(rest).items()})
+            return out
+
+        pl = {
+            "grad_norm": layer_norms(grads),
+            "param_norm": layer_norms(params),
+        }
+    return assemble_stats(
+        loss=loss,
+        grad_sq=reduced(grads, tree_sq),
+        grad_bad=reduced(grads, tree_nonfinite),
+        param_sq=reduced(params, tree_sq),
+        update_sq=reduced(updates, tree_sq),
+        update_bad=reduced(updates, tree_nonfinite),
+        per_layer=pl,
+    )
+
+
 def pp_schedule_stats(n_stages: int, n_microbatches: int,
                       schedule: str) -> dict:
     """Analytic schedule profile: bubble fraction (idle slots over total
@@ -153,6 +206,7 @@ def make_pp_train_step(
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
     schedule: str = "gpipe",
+    health: Optional[HealthConfig] = None,
 ):
     """Compiled pipeline-parallel train step for a ``tpu_ddp.models.vit.ViT``.
 
@@ -174,6 +228,7 @@ def make_pp_train_step(
             model, tx, mesh, state_template,
             n_microbatches=n_microbatches, data_axis=data_axis,
             pipe_axis=pipe_axis, loss_fn=loss_fn, donate=donate,
+            health=health,
         )
     if schedule != "gpipe":
         raise ValueError(f"unknown pp schedule {schedule!r}")
@@ -281,6 +336,16 @@ def make_pp_train_step(
             "accuracy": lax.psum(correct, data_axis)
             / jnp.maximum(lax.psum(count, data_axis), 1.0),
         }
+        if health is not None:
+            hstats = _pp_health_stats(
+                health, loss=loss, grads=grads, params=state.params,
+                updates=updates, pipe_axis=pipe_axis,
+            )
+            new_params, new_opt_state = guard_step(
+                health, hstats, (new_params, new_opt_state),
+                (state.params, state.opt_state),
+            )
+            metrics["health"] = hstats
         return (
             state.replace(
                 step=state.step + 1, params=new_params, opt_state=new_opt_state
@@ -369,6 +434,7 @@ def make_pp_1f1b_train_step(
     pipe_axis: str = PIPELINE_AXIS,
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
+    health: Optional[HealthConfig] = None,
 ):
     """1F1B (PipeDream-flush) pipeline schedule with full recompute —
     Megatron's memory-lean configuration, compiled as ONE lax.scan.
@@ -573,6 +639,16 @@ def make_pp_1f1b_train_step(
             "accuracy": lax.psum(correct, data_axis)
             / jnp.maximum(lax.psum(count, data_axis), 1.0),
         }
+        if health is not None:
+            hstats = _pp_health_stats(
+                health, loss=loss, grads=grads, params=params,
+                updates=updates, pipe_axis=pipe_axis,
+            )
+            new_params, new_opt_state = guard_step(
+                health, hstats, (new_params, new_opt_state),
+                (params, state.opt_state),
+            )
+            metrics["health"] = hstats
         return (
             state.replace(
                 step=state.step + 1, params=new_params,

@@ -16,7 +16,7 @@ O(T^2) to O(T * T/n_seq), which is what makes long sequences fit at all.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 
@@ -27,6 +27,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_ddp.compat import GRAD_SYNC_IN_AD
+from tpu_ddp.health.stats import HealthConfig, guard_step, health_stats
 from tpu_ddp.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
 from tpu_ddp.train.losses import cross_entropy_loss
 from tpu_ddp.train.state import TrainState
@@ -41,6 +42,7 @@ def make_sp_train_step(
     seq_axis: str = SEQUENCE_AXIS,
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
+    health: Optional[HealthConfig] = None,
 ):
     """Compiled train step for an SP-aware model (ViT with sp_axis=seq_axis).
 
@@ -75,11 +77,25 @@ def make_sp_train_step(
             loss = lax.pmean(loss, data_axis)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss}
+        if health is not None:
+            # grads are synced over BOTH mesh axes by this point (either
+            # sync mode), so the in-graph stats are true globals on every
+            # (data, seq) shard — same schema as the DP step
+            hstats = health_stats(
+                loss=loss, grads=grads, params=state.params,
+                updates=updates, per_layer=health.per_layer,
+            )
+            new_params, new_opt_state = guard_step(
+                health, hstats, (new_params, new_opt_state),
+                (state.params, state.opt_state),
+            )
+            metrics["health"] = hstats
         return (
             state.replace(
                 step=state.step + 1, params=new_params, opt_state=new_opt_state
             ),
-            {"loss": loss},
+            metrics,
         )
 
     batch_specs = {

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_ddp.health.stats import HealthConfig, guard_step, health_stats
 from tpu_ddp.train.losses import combine_aux_loss
 
 from tpu_ddp.parallel.mesh import DATA_AXIS, MODEL_AXIS
@@ -94,6 +95,7 @@ def make_sharded_train_step(
     aux_weight: float = 0.01,
     remat: bool = False,
     grad_accum_steps: int = 1,
+    health: Optional["HealthConfig"] = None,
 ):
     """GSPMD train step: params laid out by `param_specs`, batch sharded over
     `data_axis`; gradient averaging over the data axis and every TP collective
@@ -149,6 +151,21 @@ def make_sharded_train_step(
         metrics = {"loss": task}
         if aux is not None:
             metrics["aux_loss"] = aux
+        if health is not None:
+            # GSPMD path: these are GLOBAL logical arrays — the norm
+            # reductions lower to the same sharded-reduce + all-reduce the
+            # partitioner picks for the update itself, so the stats are
+            # computed where the (possibly ZeRO-scattered) values live
+            hstats = health_stats(
+                loss=task, grads=grads, params=state.params,
+                updates=updates, per_layer=health.per_layer,
+            )
+            new_params, new_stats, new_opt_state = guard_step(
+                health, hstats,
+                (new_params, new_stats, new_opt_state),
+                (state.params, state.batch_stats, state.opt_state),
+            )
+            metrics["health"] = hstats
         return (
             state.replace(
                 step=state.step + 1,
@@ -247,6 +264,7 @@ def make_tp_train_step(
     aux_weight: float = 0.01,
     remat: bool = False,
     grad_accum_steps: int = 1,
+    health: Optional[HealthConfig] = None,
 ):
     """Tensor-parallel (optionally DP x TP on a 2-D mesh) train step; pass
     ``rules=CNN_TP_RULES`` + ``has_batch_stats=True`` for the conv families.
@@ -258,7 +276,7 @@ def make_tp_train_step(
         data_axis=data_axis, loss_fn=loss_fn, donate=donate,
         has_batch_stats=has_batch_stats,
         aux_weight=aux_weight, remat=remat,
-        grad_accum_steps=grad_accum_steps,
+        grad_accum_steps=grad_accum_steps, health=health,
     )
     return build(state_template)
 
@@ -277,6 +295,7 @@ def make_fsdp_tp_train_step(
     aux_weight: float = 0.01,
     remat: bool = False,
     grad_accum_steps: int = 1,
+    health: Optional[HealthConfig] = None,
 ):
     """2-D FSDP x TP on a ``data x model`` mesh — the scaling-book layout:
     every big tensor is Megatron-sharded over ``model`` (its collectives
@@ -294,7 +313,7 @@ def make_fsdp_tp_train_step(
         data_axis=data_axis, loss_fn=loss_fn, donate=donate,
         has_batch_stats=has_batch_stats,
         aux_weight=aux_weight, remat=remat,
-        grad_accum_steps=grad_accum_steps,
+        grad_accum_steps=grad_accum_steps, health=health,
     )
     return build(state_template)
 
@@ -313,6 +332,7 @@ def make_fsdp_train_step(
     aux_weight: float = 0.01,
     remat: bool = False,
     grad_accum_steps: int = 1,
+    health: Optional[HealthConfig] = None,
 ):
     """ZeRO-3/FSDP step: params + optimizer state scattered over `shard_axis`
     (each device stores 1/N of every big tensor; XLA all-gathers params for
@@ -325,6 +345,6 @@ def make_fsdp_train_step(
         data_axis=data_axis, loss_fn=loss_fn, donate=donate,
         has_batch_stats=has_batch_stats,
         aux_weight=aux_weight, remat=remat,
-        grad_accum_steps=grad_accum_steps,
+        grad_accum_steps=grad_accum_steps, health=health,
     )
     return build(state_template)

@@ -36,9 +36,15 @@ def find_trace_files(path: str) -> List[str]:
     )
 
 
-def read_records(paths: Iterable[str]) -> List[dict]:
+def read_records(paths: Iterable[str], *,
+                 schema_version: int = SCHEMA_VERSION,
+                 kind: str = "trace") -> List[dict]:
     """Parse JSONL records, skipping torn trailing lines (a crash mid-write
-    leaves at most one) and refusing records from a future schema."""
+    leaves at most one) and refusing records from a future schema.
+
+    ``schema_version``/``kind`` let the other schema-versioned JSONL
+    consumers (the health summarizer) share this loop instead of forking
+    the torn-line/future-schema handling."""
     records: List[dict] = []
     for path in paths:
         with open(path) as f:
@@ -51,10 +57,10 @@ def read_records(paths: Iterable[str]) -> List[dict]:
                 except json.JSONDecodeError:
                     continue  # torn final line from a crash — expected
                 version = rec.get("schema_version")
-                if version is not None and version > SCHEMA_VERSION:
+                if version is not None and version > schema_version:
                     raise ValueError(
-                        f"{path}: trace schema_version {version} is newer "
-                        f"than this tool understands ({SCHEMA_VERSION})"
+                        f"{path}: {kind} schema_version {version} is newer "
+                        f"than this tool understands ({schema_version})"
                     )
                 records.append(rec)
     return records

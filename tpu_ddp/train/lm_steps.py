@@ -17,7 +17,7 @@ in ``train/steps.py`` / ``parallel/sequence_parallel.py``):
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 
@@ -28,8 +28,25 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_ddp.compat import GRAD_SYNC_IN_AD
+from tpu_ddp.health.stats import HealthConfig, guard_step, health_stats
 from tpu_ddp.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
 from tpu_ddp.train.state import TrainState
+
+
+def _with_health(health, *, loss, grads, params, updates, new_params,
+                 new_opt_state, old_opt_state):
+    """Shared flight-recorder tail for the LM steps: stats on the synced
+    grads/updates + the optional skip-step guard. Returns
+    ``(hstats, new_params, new_opt_state)``; no-op when health is None."""
+    hstats = health_stats(
+        loss=loss, grads=grads, params=params, updates=updates,
+        per_layer=health.per_layer,
+    )
+    new_params, new_opt_state = guard_step(
+        health, hstats, (new_params, new_opt_state),
+        (params, old_opt_state),
+    )
+    return hstats, new_params, new_opt_state
 
 
 def _token_nll(logits, targets):
@@ -45,6 +62,7 @@ def make_lm_train_step(
     *,
     data_axis: str = DATA_AXIS,
     donate: bool = True,
+    health: Optional[HealthConfig] = None,
 ) -> Callable:
     """step(state, {"tokens": (B, T) int32}) -> (state, {"loss"})."""
 
@@ -66,10 +84,17 @@ def make_lm_train_step(
             loss = lax.pmean(loss, data_axis)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss}
+        if health is not None:
+            metrics["health"], new_params, new_opt = _with_health(
+                health, loss=loss, grads=grads, params=state.params,
+                updates=updates, new_params=new_params,
+                new_opt_state=new_opt, old_opt_state=state.opt_state,
+            )
         return (
             state.replace(step=state.step + 1, params=new_params,
                           opt_state=new_opt),
-            {"loss": loss},
+            metrics,
         )
 
     sharded = jax.shard_map(
@@ -88,6 +113,7 @@ def make_sp_lm_train_step(
     data_axis: str = DATA_AXIS,
     seq_axis: str = SEQUENCE_AXIS,
     donate: bool = True,
+    health: Optional[HealthConfig] = None,
 ) -> Callable:
     """Sequence-parallel next-token step. ``model`` must be built with
     ``sp_axis=seq_axis``; tokens arrive (B_local, T_local) per shard."""
@@ -134,10 +160,20 @@ def make_sp_lm_train_step(
             loss = lax.pmean(loss * n_seq, data_axis)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss}
+        if health is not None:
+            # grads are fully synced over BOTH axes at this point (AD of
+            # the psum'd/pmean'd loss, or the explicit pmean-of-psum
+            # above), so the stats are (data x seq)-replicated globals
+            metrics["health"], new_params, new_opt = _with_health(
+                health, loss=loss, grads=grads, params=state.params,
+                updates=updates, new_params=new_params,
+                new_opt_state=new_opt, old_opt_state=state.opt_state,
+            )
         return (
             state.replace(step=state.step + 1, params=new_params,
                           opt_state=new_opt),
-            {"loss": loss},
+            metrics,
         )
 
     sharded = jax.shard_map(

@@ -41,6 +41,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_ddp.compat import GRAD_SYNC_IN_AD
+from tpu_ddp.health.stats import HealthConfig, guard_step, health_stats
 from tpu_ddp.parallel.mesh import DATA_AXIS
 from tpu_ddp.train.losses import (
     combine_aux_loss,
@@ -89,9 +90,18 @@ def _make_shard_step(
     augment_seed: int = 0,
     mixup_alpha: float = 0.0,
     aux_weight: float = 0.01,
+    health: Optional[HealthConfig] = None,
 ):
     """Per-shard train-step body shared by the single-step and scanned
     variants: forward, pmean'd loss (the gradient allreduce), optax update.
+
+    ``health`` compiles the numerics flight recorder into the step (see
+    ``tpu_ddp.health.stats``): a ``metrics["health"]`` dict of global
+    norms + finite-ness sentinels computed on the already-synchronized
+    gradients/updates, and (``skip_nonfinite``) the in-graph guard that
+    keeps the old params/batch_stats/opt_state when the update is
+    poisoned. ``health=None`` (default) leaves the traced step byte-
+    identical to a build without the feature.
 
     Models that sow auxiliary losses into the ``aux_loss`` collection (the
     MoE router's load-balance term, ``models.moe.MoEMlp``) get them added to
@@ -171,6 +181,20 @@ def _make_shard_step(
                 grads, state.opt_state, state.params
             )
             new_params = optax.apply_updates(state.params, updates)
+        if health is not None:
+            # grads are the synchronized values here in BOTH sync modes
+            # (AD-of-pmean'd-loss, or the explicit pmean above), so every
+            # shard computes identical global stats in-graph.
+            hstats = health_stats(
+                loss=lax.pmean(task, data_axis), grads=grads,
+                params=state.params, updates=updates,
+                per_layer=health.per_layer,
+            )
+            new_params, new_stats, new_opt_state = guard_step(
+                health, hstats,
+                (new_params, new_stats, new_opt_state),
+                (state.params, state.batch_stats, state.opt_state),
+            )
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
@@ -178,6 +202,8 @@ def _make_shard_step(
             opt_state=new_opt_state,
         )
         metrics = {"loss": lax.pmean(task, data_axis)}
+        if health is not None:
+            metrics["health"] = hstats
         if aux is not None:
             metrics["aux_loss"] = lax.pmean(aux, data_axis)
         if compute_accuracy:
@@ -206,6 +232,7 @@ def make_train_step(
     augment_seed: int = 0,
     mixup_alpha: float = 0.0,
     aux_weight: float = 0.01,
+    health: Optional[HealthConfig] = None,
 ) -> Callable[[TrainState, Batch], tuple]:
     """Build the compiled DDP train step for `mesh`.
 
@@ -229,6 +256,7 @@ def make_train_step(
         augment_seed=augment_seed,
         mixup_alpha=mixup_alpha,
         aux_weight=aux_weight,
+        health=health,
     )
     sharded = jax.shard_map(
         shard_step,
@@ -254,6 +282,7 @@ def make_scan_train_step(
     augment_seed: int = 0,
     mixup_alpha: float = 0.0,
     aux_weight: float = 0.01,
+    health: Optional[HealthConfig] = None,
 ) -> Callable[[TrainState, Batch], tuple]:
     """K train steps fused into ONE dispatch via ``lax.scan``.
 
@@ -280,6 +309,7 @@ def make_scan_train_step(
         augment_seed=augment_seed,
         mixup_alpha=mixup_alpha,
         aux_weight=aux_weight,
+        health=health,
     )
 
     def shard_multi(state: TrainState, batches: Batch):
@@ -306,6 +336,7 @@ def make_grad_accum_train_step(
     compute_accuracy: bool = True,
     remat: bool = False,
     aux_weight: float = 0.01,
+    health: Optional[HealthConfig] = None,
 ) -> Callable[[TrainState, Batch], tuple]:
     """ONE optimizer step over a global batch too large to activate at
     once: each shard splits its rows into ``accum_steps`` microbatches,
@@ -399,6 +430,20 @@ def make_grad_accum_train_step(
         new_stats = jax.tree.map(lambda s: lax.pmean(s, data_axis), new_stats)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        if health is not None:
+            # same guarantees as _make_shard_step: grads/updates are the
+            # synchronized values the optimizer consumed (the accumulated
+            # average), so the stats are the true full-batch numbers
+            hstats = health_stats(
+                loss=lax.pmean(loss_sum / accum_steps, data_axis),
+                grads=grads, params=state.params, updates=updates,
+                per_layer=health.per_layer,
+            )
+            new_params, new_stats, new_opt_state = guard_step(
+                health, hstats,
+                (new_params, new_stats, new_opt_state),
+                (state.params, state.batch_stats, state.opt_state),
+            )
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
@@ -406,6 +451,8 @@ def make_grad_accum_train_step(
             opt_state=new_opt_state,
         )
         metrics = {"loss": lax.pmean(loss_sum / accum_steps, data_axis)}
+        if health is not None:
+            metrics["health"] = hstats
         if compute_accuracy:
             metrics["accuracy"] = lax.psum(correct, data_axis) / jnp.maximum(
                 lax.psum(count, data_axis), 1.0

@@ -248,6 +248,7 @@ def build_strategy(
     initial_state: Optional[TrainState] = None,
     remat: bool = False,
     grad_accum_steps: int = 1,
+    health=None,
 ) -> Strategy:
     """Build the full strategy for any non-dp mode on a prebuilt mesh. (The
     dp path stays in Trainer: its shard_map step, scan fusion, and
@@ -262,6 +263,11 @@ def build_strategy(
     (fsdp/tp/fsdp_tp/ep — round-4 verdict item 4: the memory-bound
     configs need the memory knobs most); pp/sp raise (their step builders
     own their own microbatching/remat story).
+
+    ``health`` (a ``tpu_ddp.health.HealthConfig`` or None) threads the
+    numerics flight recorder into whichever family's step builder is
+    selected — every mode reports the same ``metrics["health"]`` schema
+    (docs/health.md).
     """
     from tpu_ddp.parallel.partitioning import shard_train_state
     from tpu_ddp.train.steps import make_eval_step, make_predict_step
@@ -289,7 +295,8 @@ def build_strategy(
         # are identical by construction (models/vit.py docstring).
         state = initial_state or create_train_state(plain, tx, rng)
         state = jax.device_put(state, replicated)
-        step = make_sp_train_step(sp_model, tx, mesh, loss_fn=loss_fn)
+        step = make_sp_train_step(
+            sp_model, tx, mesh, loss_fn=loss_fn, health=health)
         # Eval/predict also run the plain module: attention math is the
         # same, so the standard shard_map eval replicates over the sequence
         # axis and stays exact.
@@ -348,7 +355,7 @@ def build_strategy(
         step, shardings = make_pp_train_step(
             model, tx, mesh, state,
             n_microbatches=n_microbatches, loss_fn=loss_fn,
-            schedule=pp_schedule,
+            schedule=pp_schedule, health=health,
         )
         state = shard_train_state(state, shardings)
         from tpu_ddp.parallel.pipeline import pp_schedule_stats
@@ -399,6 +406,7 @@ def build_strategy(
             model, tx, mesh, state,
             loss_fn=loss_fn, has_batch_stats=has_bs, aux_weight=aux_weight,
             remat=remat, grad_accum_steps=grad_accum_steps,
+            health=health,
         )
     elif parallelism == "tp":
         from tpu_ddp.parallel.tensor_parallel import make_tp_train_step
@@ -409,6 +417,7 @@ def build_strategy(
             model, tx, mesh, state, rules=_tp_rules_for(model, parallelism),
             loss_fn=loss_fn, has_batch_stats=has_bs, aux_weight=aux_weight,
             remat=remat, grad_accum_steps=grad_accum_steps,
+            health=health,
         )
     elif parallelism == "fsdp_tp":
         # Scaling-book 2-D layout: Megatron TP over `model` + ZeRO-3
@@ -422,6 +431,7 @@ def build_strategy(
             model, tx, mesh, state, rules=_tp_rules_for(model, parallelism),
             loss_fn=loss_fn, has_batch_stats=has_bs, aux_weight=aux_weight,
             remat=remat, grad_accum_steps=grad_accum_steps,
+            health=health,
         )
     elif parallelism == "ep":
         _require_model(model, ("moe",), "ep")
@@ -432,6 +442,7 @@ def build_strategy(
         step, shardings = make_ep_train_step(
             model, tx, mesh, state, loss_fn=loss_fn, aux_weight=aux_weight,
             remat=remat, grad_accum_steps=grad_accum_steps,
+            health=health,
         )
     else:
         raise ValueError(f"unknown parallelism {parallelism!r}")

@@ -134,6 +134,62 @@ class TrainConfig:
     watchdog_deadline_seconds: float = 0.0  # >0: hang watchdog — stack
                                           # dump + heartbeat staleness when
                                           # no step completes in time
+    health: str = "off"                   # "on": numerics flight recorder —
+                                          # in-graph grad/param/update norms
+                                          # + NaN/Inf sentinels every step
+                                          # (docs/health.md). Adds one
+                                          # scalar fetch per step on host
+    health_policy: str = "warn"           # on anomaly: warn | skip_step
+                                          # (in-graph guard discards the
+                                          # poisoned update, optimizer
+                                          # state stays in sync) | halt
+                                          # (drain + final checkpoint)
+    health_per_layer_stride: int = 0      # >0: per-layer grad/param norm
+                                          # breakdown compiled into the
+                                          # step, recorded every N steps
+                                          # (and always in anomaly dumps)
+    health_dir: Optional[str] = None      # health JSONL + anomalies/ run
+                                          # dir; defaults to telemetry_dir
+    health_window: int = 128              # spike detector rolling window
+    health_spike_threshold: float = 10.0  # spike at median + K * MAD
+
+    def validate(self) -> "TrainConfig":
+        """Fail fast on knob values that would otherwise only explode
+        mid-run (the sinks are parsed at Trainer construction, the health
+        policy on the first anomaly — both too late). Returns self so
+        call sites can chain."""
+        from tpu_ddp.telemetry import DEFAULT_SINKS
+
+        valid_sinks = tuple(DEFAULT_SINKS.split(","))
+        for name in (self.telemetry_sinks or "").split(","):
+            name = name.strip()
+            if name and name not in valid_sinks:
+                raise ValueError(
+                    f"unknown telemetry sink {name!r}; valid sinks: "
+                    f"{', '.join(valid_sinks)}"
+                )
+        if self.health not in ("off", "on"):
+            raise ValueError(
+                f"unknown health mode {self.health!r}; valid modes: "
+                "off, on"
+            )
+        from tpu_ddp.health import POLICIES
+
+        if self.health_policy not in POLICIES:
+            raise ValueError(
+                f"unknown health policy {self.health_policy!r}; valid "
+                f"policies: {', '.join(POLICIES)}"
+            )
+        if self.health_per_layer_stride < 0:
+            raise ValueError(
+                "health_per_layer_stride must be >= 0, got "
+                f"{self.health_per_layer_stride}"
+            )
+        if self.health_window < 4:
+            raise ValueError(
+                f"health_window must be >= 4, got {self.health_window}"
+            )
+        return self
     freeze_prefixes: Optional[tuple] = None  # e.g. ("fc",) trains head only
     loss: str = "ce"                      # "ce" | "bce" (multi-label,
                                           # ppe_main_ddp.py:147)
@@ -227,6 +283,7 @@ class Trainer:
         """train_data/test_data: optional (images, labels) tuples that bypass
         the dataset loader — used by the k-fold driver and tests."""
         self.config = config
+        config.validate()
         devices = jax.devices()
         if config.n_devices:
             devices = devices[: config.n_devices]
@@ -269,6 +326,40 @@ class Trainer:
             process_index=self.process_index,
         )
         self._watchdog = None
+        # Numerics flight recorder (docs/health.md): the in-graph half is
+        # compiled into the step builders below (health=self._health);
+        # this monitor is the host half — JSONL record, spike detection,
+        # anomaly dumps, policy verdicts.
+        self._health_monitor = None
+        self._health = None
+        self._health_halted = None
+        if config.health != "off":
+            from tpu_ddp.health import HealthConfig, HealthMonitor
+
+            self._health = HealthConfig(
+                per_layer=config.health_per_layer_stride > 0,
+                skip_nonfinite=config.health_policy == "skip_step",
+            )
+            if not (config.health_dir or config.telemetry_dir):
+                # legitimate (the in-graph sentinels + policy still run,
+                # e.g. skip_step-only protection) but easy to mistake for
+                # a recorded run — say so up front
+                log.warning(
+                    "health=on with neither health_dir nor telemetry_dir:"
+                    " detection and the %r policy are active, but no "
+                    "health JSONL or anomaly dumps will be written",
+                    config.health_policy,
+                )
+            self._health_monitor = HealthMonitor(
+                run_dir=config.health_dir or config.telemetry_dir,
+                policy=config.health_policy,
+                per_layer_stride=config.health_per_layer_stride,
+                telemetry=self.telemetry,
+                process_index=self.process_index,
+                window=config.health_window,
+                spike_threshold=config.health_spike_threshold,
+                run_meta=dataclasses.asdict(config),
+            )
         if config.profile_dir:
             # satellite fix: create the profiler dir up front — a typo'd
             # path fails NOW, not after an epoch of training
@@ -417,6 +508,7 @@ class Trainer:
                 accum_steps=config.grad_accum_steps,
                 loss_fn=loss_fn, compute_accuracy=with_acc,
                 remat=config.remat, aux_weight=config.aux_weight,
+                health=self._health,
             )
         else:
             self.train_step = make_train_step(
@@ -425,6 +517,7 @@ class Trainer:
                 augment=config.augment, augment_seed=config.seed,
                 mixup_alpha=config.mixup_alpha,
                 aux_weight=config.aux_weight,
+                health=self._health,
             )
         self.multi_step = None
         # Clamp to the epoch length: a scan longer than the epoch would
@@ -450,6 +543,7 @@ class Trainer:
                 augment=config.augment, augment_seed=config.seed,
                 mixup_alpha=config.mixup_alpha,
                 aux_weight=config.aux_weight,
+                health=self._health,
             )
             self.stacked_sharding = stacked_batch_sharding(self.mesh)
         self.eval_step = make_eval_step(
@@ -512,6 +606,7 @@ class Trainer:
             initial_state=initial,
             remat=config.remat,
             grad_accum_steps=config.grad_accum_steps,
+            health=self._health,
         )
         self.state = strategy.state
         self.train_step = strategy.train_step
@@ -726,23 +821,52 @@ class Trainer:
         while in_flight:
             yield emit()
 
-    def close(self) -> None:
-        """Release the host prefetcher (worker thread + slot buffers), stop
-        the watchdog, and finalize the telemetry sinks (writes the Chrome
-        trace, prints the phase summary). Idempotent."""
+    def _release_workers(self) -> None:
+        """Stop the host-side helpers: prefetcher (worker thread + slot
+        buffers), watchdog, and the health monitor (flushes its JSONL
+        footer). Idempotent; does NOT close the telemetry sinks."""
         if self._prefetcher is not None:
             self._prefetcher.close()
             self._prefetcher = None
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
+        if self._health_monitor is not None:
+            self._health_monitor.close()
+
+    def close(self) -> None:
+        """Release the workers and finalize the telemetry sinks (writes the
+        Chrome trace, prints the phase summary). Idempotent."""
+        self._release_workers()
         self.telemetry.close()
 
-    def run(self) -> dict:
+    def run(self, *, close: bool = True) -> dict:
+        """Train. ``close=False`` keeps the telemetry sinks open (workers
+        are still released) so the caller can fold post-run results into
+        the final counters snapshot — ``record_final_eval`` — before
+        calling ``close()`` itself; the CLI does exactly that, making the
+        JSONL trace a self-contained run record."""
         try:
             return self._run_impl()
         finally:
-            self.close()
+            self._release_workers()
+            if close:
+                self.close()
+
+    def record_final_eval(self, *, accuracy=None, loss=None) -> None:
+        """Mirror end-of-run eval results into telemetry gauges
+        (``eval/final_test_*``, plus ``eval/best_test_accuracy`` when
+        --keep-best tracked one) so the final counters snapshot — emitted
+        by ``close()`` — carries them. No-op with telemetry disabled."""
+        tel = self.telemetry
+        if not tel.enabled:
+            return
+        if accuracy is not None:
+            tel.gauge("eval/final_test_accuracy").set(accuracy)
+        if loss is not None:
+            tel.gauge("eval/final_test_loss").set(loss)
+        if self._best_acc != float("-inf"):
+            tel.gauge("eval/best_test_accuracy").set(self._best_acc)
 
     def _run_impl(self) -> dict:
         c = self.config
@@ -862,9 +986,13 @@ class Trainer:
             epoch_metrics = None
             n_steps = 0
             # host-side global step mirror (one device sync per epoch),
-            # kept for BOTH consumers so watchdog heartbeats/hang logs
-            # carry the global step even with telemetry off
-            track_step = tel.enabled or self._watchdog is not None
+            # kept for ALL consumers so watchdog heartbeats/hang logs and
+            # health records carry the global step even with telemetry off
+            track_step = (
+                tel.enabled
+                or self._watchdog is not None
+                or self._health_monitor is not None
+            )
             host_step = int(self.state.step) if track_step else 0
             tel.current_step = host_step
             skip = resume_skip if epoch == start_epoch + 1 else 0
@@ -918,6 +1046,18 @@ class Trainer:
                     # still catches wedged collectives (the host blocks
                     # inside the NEXT dispatch when the device queue jams)
                     self._watchdog.beat(host_step)
+                if self._health_monitor is not None:
+                    dn = self.steps_per_call if kind == "stacked" else 1
+                    verdict = self._on_health(
+                        host_step - dn, epoch_metrics.pop("health"),
+                        kind, dev_batch,
+                    )
+                    if verdict == "halt":
+                        # stats are replicated globals — every host reaches
+                        # the same verdict at the same step, so breaking
+                        # here cannot wedge a pod in mismatched collectives
+                        self._health_halted = host_step
+                        break
                 if mfu_probe is None:
                     mfu_probe = (kind, dev_batch)
                 throughput.add(n_real)
@@ -979,6 +1119,15 @@ class Trainer:
                 )
                 last_metrics["preempted"] = True
                 break  # the tail below writes the final checkpoint
+            if self._health_halted is not None:
+                self.logger.log_text(
+                    f"health anomaly at step {self._health_halted} with "
+                    "policy 'halt': stopping training"
+                    + (" (saving final checkpoint)" if self.checkpointer
+                       else "")
+                )
+                last_metrics["health_halted"] = True
+                break  # same drain path as preemption
             if epoch > start_epoch + 1:  # device_get above = a sync boundary
                 steady_seconds += (
                     time.perf_counter() - epoch_t0 - trace_dump_seconds
@@ -1013,6 +1162,13 @@ class Trainer:
                 with tel.span("eval", epoch=epoch):
                     acc, loss = self.evaluate()
                 self.history.setdefault("test_loss", []).append(loss)
+                if tel.enabled:
+                    # last-write-wins gauges: the final counters snapshot
+                    # then carries the end-of-run eval — the JSONL trace
+                    # is a self-contained run record
+                    tel.gauge("eval/test_loss").set(loss)
+                    if c.loss == "ce":
+                        tel.gauge("eval/test_accuracy").set(acc)
                 if c.loss == "ce":  # accuracy undefined for multi-hot targets
                     self.logger.log(
                         int(self.state.step), test_accuracy=acc, test_loss=loss
@@ -1062,7 +1218,29 @@ class Trainer:
         total = time.time() - start
         # reference wall-clock line: main.py:49
         self.logger.log_text(f"training time: {total:.3f} seconds")
-        if self.checkpointer:
+        save_final = self.checkpointer is not None
+        if save_final and self._health_halted is not None:
+            # A halt on a NON-FINITE anomaly means the poisoned update was
+            # applied (halt compiles no skip guard): checkpointing that
+            # state would make NaN params the newest checkpoint --resume
+            # restores. Keep the last good periodic checkpoint as latest
+            # instead. A finite halt state (loss spike) is still saved.
+            finite = all(
+                bool(np.isfinite(leaf).all())
+                for leaf in jax.tree.leaves(
+                    jax.device_get(self.state.params))
+            )
+            if not finite:
+                save_final = False
+                prev = self.checkpointer.latest_step()
+                self.logger.log_text(
+                    "health halt: final params are non-finite; NOT "
+                    "checkpointing them ("
+                    + (f"latest good checkpoint remains step {prev}"
+                       if prev is not None else "no checkpoint exists")
+                    + ")"
+                )
+        if save_final:
             self.checkpointer.save(int(self.state.step), self.state, wait=True)
         if self.best_checkpointer:
             self.best_checkpointer.manager.wait_until_finished()
@@ -1094,6 +1272,51 @@ class Trainer:
             record_mfu(tel.registry, last_metrics.get("mfu"))
             # final snapshot lands via tel.close() in Trainer.close()
         return last_metrics
+
+    def _on_health(self, step_base, health_out, kind, dev_batch) -> str:
+        """Feed one dispatch's in-graph health stats to the monitor: ONE
+        device_get for the scalar subtree (a fused K-step group carries
+        (K,) leaves, unstacked here into K per-step records), the batch
+        fetched lazily only if an anomaly dump fires. Returns the
+        strongest policy verdict across the group's steps."""
+        K = self.steps_per_call if kind == "stacked" else 1
+        per_layer = health_out.pop("per_layer", None)
+        host = jax.device_get(health_out)
+        if per_layer is not None:
+            # the per-layer tree (2 scalars per param leaf) is only
+            # consumed on stride steps or when a sentinel tripped — keep
+            # the healthy-path fetch to the handful of scalars above
+            stride = self._health_monitor.per_layer_stride
+            want = not bool(np.asarray(host["all_finite"]).all()) or (
+                stride and any(
+                    (step_base + j) % stride == 0 for j in range(K))
+            )
+            if want:
+                host["per_layer"] = jax.device_get(per_layer)
+        verdict = "ok"
+        for j in range(K):
+            stats = (
+                jax.tree.map(lambda x: x[j] if np.ndim(x) else x, host)
+                if K > 1 else host
+            )
+
+            def batch_provider(j=j):
+                if self._multihost:
+                    # the global batch is not host-addressable; the dump
+                    # carries stats + history only (per-host batches could
+                    # be reassembled from the loaders if ever needed)
+                    return None
+                b = jax.device_get(dev_batch)
+                if kind == "stacked":
+                    b = {k: v[j] for k, v in b.items()}
+                return b
+
+            v = self._health_monitor.on_step(
+                step_base + j, stats, batch_provider=batch_provider
+            )
+            if v == "halt":
+                verdict = "halt"
+        return verdict
 
     def _compute_mfu(self, mfu_probe, steady_steps, steady_seconds):
         """Model FLOPs Utilization of the steady-state epochs, or None.
