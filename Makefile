@@ -4,7 +4,7 @@
 PYTHON ?= python
 
 .PHONY: test test-all dryrun bench smoke capture aot real-data lint \
-	trace-demo health-demo
+	trace-demo health-demo zero-demo
 
 # Fast default loop (round-3 verdict item 5): skips the `slow`-marked
 # multi-process / end-to-end-CLI / AOT tests. CI and pre-commit should run
@@ -81,6 +81,14 @@ health-demo:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  $(PYTHON) -m tpu_ddp.tools.health_demo --dir $(HEALTH_DEMO_DIR)
 	$(PYTHON) -m tpu_ddp.cli.main health $(HEALTH_DEMO_DIR)
+
+# ZeRO-1 acceptance: train the same config replicated and with --zero1 on
+# 4 virtual CPU devices; exits non-zero unless the loss trajectories and
+# final params match AND the optimizer state is physically scattered 1/N
+# per device (tpu_ddp/tools/zero_demo.py).
+zero-demo:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+	  $(PYTHON) -m tpu_ddp.tools.zero_demo --devices 4
 
 # 2-epoch end-to-end CLI run on the virtual mesh (fast sanity check).
 smoke:

@@ -344,6 +344,75 @@ def _bench_dispatch_baseline() -> dict:
     }
 
 
+def _bench_zero1() -> dict:
+    """ZeRO-1 weight-update sharding (--zero1) on the SAME model/batch as
+    the dispatch-per-step DP baseline: one row with images/sec/chip plus
+    the compiled step's per-device memory next to the replicated row's —
+    the bench-JSON evidence for the 1/N optimizer-state claim
+    (parallel/zero.py; AOT ground truth in benchmarks/aot_v5e.json)."""
+    import jax
+    import numpy as np
+
+    from tpu_ddp.data import synthetic_cifar10
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
+    from tpu_ddp.parallel.zero import Zero1Partition
+    from tpu_ddp.train import create_train_state, make_optimizer, make_train_step
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = create_mesh(MeshSpec(data=-1), devices)
+    model = NetResDeep()
+    # momentum so there IS param-sized optimizer state to shard (the
+    # reference's SGD lr=1e-2 is stateless — nothing to scatter)
+    tx_rep = make_optimizer(lr=1e-2, momentum=0.9)
+    tx = make_optimizer(lr=1e-2, momentum=0.9, zero1_axis="data")
+    state = create_train_state(model, tx_rep, jax.random.key(0))
+    part = Zero1Partition(tx, state.params, n_chips)
+    state = part.shard_state(state, mesh)
+    step = make_train_step(model, tx, mesh, zero1=part)
+
+    per_shard = 32
+    global_batch = per_shard * n_chips
+    imgs, labels = synthetic_cifar10(global_batch, seed=0)
+    batch = jax.device_put(
+        {
+            "image": imgs.astype(np.float32),
+            "label": labels,
+            "mask": np.ones(global_batch, bool),
+        },
+        batch_sharding(mesh),
+    )
+    _, calls, elapsed = _measure(
+        step, state, batch, target_seconds=4.0, max_calls=400
+    )
+    per_chip = calls * global_batch / elapsed / n_chips
+    row = {
+        "images_per_sec_per_chip": round(per_chip, 1),
+        "model": "netresdeep",
+        "dtype": "float32",
+        "per_shard_batch": per_shard,
+        "steps_per_call": 1,
+        "momentum": 0.9,
+        "n_chips": n_chips,
+        "optimizer_state_accounting": part.accounting(),
+    }
+    try:  # compiler-ground-truth per-device bytes (backend permitting)
+        rep_step = make_train_step(model, tx_rep, mesh)
+        rep_state = create_train_state(model, tx_rep, jax.random.key(0))
+        for name, s, st in (("zero1", step, state),
+                            ("replicated", rep_step, rep_state)):
+            ma = s.trace(st, batch).lower().compile().memory_analysis()
+            if ma is not None:
+                row[f"{name}_argument_bytes_per_device"] = int(
+                    ma.argument_size_in_bytes)
+                row[f"{name}_temp_bytes_per_device"] = int(
+                    ma.temp_size_in_bytes)
+    except Exception:
+        pass
+    return row
+
+
 def _cifar_compute_point(model, tx, *, per_shard: int, seed: int = 1,
                          max_calls: int = 50) -> dict:
     """ONE unfused CIFAR-shape (32x32) measurement point: the single
@@ -832,6 +901,11 @@ def child_main(quick: bool) -> None:
     if per_chip and base_v:
         out["vs_baseline"] = round(per_chip / base_v, 3)
         out["vs_baseline_source"] = "measured_same_run"
+    _emit(out)
+    # ZeRO-1 row: same model/batch as the baseline, sharded weight update
+    # (--zero1) — throughput + per-device memory next to the replicated
+    # row. Cheap on any backend (NetResDeep f32).
+    _leg("zero1_weight_update_sharding", _bench_zero1)
     _emit(out)
     if _is_tpu_child():
         # Cheapest compiles first; the ResNet-50 bf16 compile is the most

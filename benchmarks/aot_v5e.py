@@ -173,6 +173,26 @@ def main() -> None:
         lambda: step50.trace(state50, batch_for(256 * 8)).lower().compile(),
     )
 
+    # 2a. The SAME compute-bound config under ZeRO-1 weight-update
+    # sharding (--zero1): the optimizer state (SGD momentum, one param-
+    # sized f32 tree) enters scattered 1/8 per device — diff this row's
+    # argument_bytes against dp_resnet50_bf16_b256x8 for the compiler-
+    # ground-truth HBM shrink the docs table quotes (docs/PERF.md).
+    def zero1_compile():
+        from tpu_ddp.parallel.partitioning import abstract_train_state
+        from tpu_ddp.parallel.zero import Zero1Partition
+
+        tz = make_optimizer(lr=1e-1, momentum=0.9, zero1_axis="data")
+        part = Zero1Partition(tz, state50.params, mesh.shape["data"])
+        sz = state50.replace(opt_state=part.opt_template)
+        sz = abstract_train_state(sz, part.state_shardings(sz, mesh))
+        stepz = make_train_step(r50, tz, mesh, zero1=part)
+        return stepz.trace(sz, batch_for(256 * 8)).lower().compile()
+
+    progs["dp_zero1_resnet50_bf16_b256x8"] = _compile(
+        "dp_zero1_resnet50_bf16_b256x8", zero1_compile,
+    )
+
     # 2b. WideResNet-28-10 bf16 (the 94%+ CIFAR margin config, 36.5M
     # params): compile + memory evidence for the newest model family.
     wrn = MODEL_REGISTRY["wrn28_10"](num_classes=10, dtype=jnp.bfloat16)

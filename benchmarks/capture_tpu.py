@@ -85,6 +85,11 @@ _LEG_CODE = {
     # attention_op shape; _derive computes the causal-vs-noncausal ratio.
     "attention_causal": "import bench; print(__import__('json').dumps("
                         "bench._bench_attention_causal()))",
+    # ZeRO-1 weight-update sharding (--zero1): same model/batch as the
+    # dispatch baseline; the row carries throughput + per-device memory
+    # for the sharded vs replicated optimizer state (the 1/N HBM claim).
+    "zero1": "import bench; print(__import__('json').dumps("
+             "bench._bench_zero1()))",
     "sweep_k32_b256": "import bench; print(__import__('json').dumps("
                       "bench._bench_flagship_point(32, 256)))",
     "sweep_k128_b32": "import bench; print(__import__('json').dumps("

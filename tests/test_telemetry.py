@@ -317,3 +317,77 @@ def test_trainer_run_dir_summarizes(devices, telemetry_run, capsys):
     out = capsys.readouterr().out
     for phase in ("data_wait", "compiled_step", "device_sync"):
         assert phase in out
+
+
+def test_checkpoint_completion_side_telemetry(tmp_path, devices):
+    """PR-3 satellite: the ``checkpoint`` span only ever covered save
+    INITIATION (orbax saves are async) — completion must be accounted too:
+    ``checkpoint/io_seconds`` + ``checkpoint/completed`` land when the
+    wait barrier observes the background IO finishing, and the barrier
+    itself is traced as a ``checkpoint_wait`` span."""
+    from tpu_ddp.checkpoint import Checkpointer
+    from tpu_ddp.telemetry.registry import reset_default_registry
+
+    reset_default_registry()
+    tel = build_telemetry(str(tmp_path / "run"), sinks="jsonl")
+    ck = Checkpointer(str(tmp_path / "ck"), telemetry=tel)
+    state = {"w": np.arange(8.0, dtype=np.float32)}
+    ck.save(1, state)            # async: completion not yet observed
+    assert len(ck._pending) == 1
+    ck.wait_until_finished()
+    assert ck._pending == []
+    assert tel.registry.counter("checkpoint/saves").value == 1
+    assert tel.registry.counter("checkpoint/completed").value == 1
+    assert tel.registry.counter("checkpoint/io_seconds").value > 0
+    ck.save(2, state, wait=True)  # sync saves self-account
+    assert tel.registry.counter("checkpoint/completed").value == 2
+    ck.close()
+    tel.close()
+    records = [json.loads(ln)
+               for ln in open(tmp_path / "run" / "trace-p0.jsonl")]
+    spans = {r["name"] for r in records if r["type"] == "span"}
+    assert "checkpoint" in spans and "checkpoint_wait" in spans
+
+
+def test_compilation_cache_counters(tmp_path, devices):
+    """PR-3 satellite: with the persistent compilation cache enabled
+    (TrainConfig.compilation_cache_dir / --compilation-cache-dir), cache
+    traffic surfaces as jax/cache/* counters in the default registry —
+    what `trace summarize` prints in its counters snapshot — so warm
+    starts are measurable, not vibes."""
+    import jax
+
+    from tpu_ddp.telemetry.jax_hooks import install_jax_hooks
+    from tpu_ddp.telemetry.registry import (
+        default_registry,
+        reset_default_registry,
+    )
+    from tpu_ddp.train.trainer import apply_compilation_cache
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        apply_compilation_cache(str(tmp_path / "xla-cache"))
+        # the helper floors at 1s (TPU compiles); CPU test compiles are
+        # sub-ms, so drop the floor to force cache traffic here
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        reset_default_registry()
+        assert install_jax_hooks()
+        f = jax.jit(lambda x: x * 3 + 1)
+        f(np.ones((16,), np.float32))          # cold: cache_misses
+        g = jax.jit(lambda y: y * 3 + 1)       # identical HLO: cache_hits
+        g(np.ones((16,), np.float32))
+        snap = default_registry().snapshot()["counters"]
+        assert snap.get("jax/cache/cache_misses", 0) >= 1
+        assert snap.get("jax/cache/cache_hits", 0) >= 1
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prev_min)
+        try:  # un-latch again so later tests re-evaluate with prev config
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+        reset_default_registry()

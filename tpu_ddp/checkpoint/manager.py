@@ -5,6 +5,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import time
 from typing import Any, Optional
 
 import jax
@@ -24,6 +25,10 @@ class Checkpointer:
         if telemetry is None:
             from tpu_ddp.telemetry import NULL as telemetry
         self.telemetry = telemetry
+        # async saves whose completion has not yet been OBSERVED:
+        # [(step, initiation monotonic time)] — drained by
+        # wait_until_finished into the completion-side telemetry
+        self._pending: list = []
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -56,12 +61,46 @@ class Checkpointer:
         self._clear_marker()
         # the span covers save INITIATION (orbax saves are async unless
         # wait=True): a long "checkpoint" slice in the trace means the
-        # save path itself is blocking training, not background IO
+        # save path itself is blocking training, not background IO. The
+        # COMPLETION side — the background IO itself — is accounted at
+        # wait_until_finished (checkpoint/io_seconds), so async saves are
+        # visible in traces instead of silently free.
+        t0 = time.monotonic()
         with self.telemetry.span("checkpoint", step=step, wait=wait):
             self.manager.save(step, args=ocp.args.StandardSave(state))
             if wait:
                 self.manager.wait_until_finished()
+        if wait:
+            # the barrier drained every older in-flight save too
+            finished, self._pending = self._pending, []
+            self._observe_completion(finished + [(step, t0)])
+        else:
+            self._pending.append((step, t0))
         self.telemetry.count("checkpoint/saves")
+
+    def _observe_completion(self, finished) -> None:
+        """Completion-side accounting for saves whose IO has landed:
+        ``checkpoint/io_seconds`` accumulates initiation->completion wall
+        time per save (an upper bound on the background IO — orbax exposes
+        no public finalize hook on this series, so completion is observed
+        at the wait barrier) and ``checkpoint/completed`` counts them.
+        ``checkpoint/saves`` minus ``completed`` in a final counters
+        snapshot therefore flags saves that never finished."""
+        now = time.monotonic()
+        for step, t0 in finished:
+            self.telemetry.count("checkpoint/io_seconds", round(now - t0, 6))
+            self.telemetry.count("checkpoint/completed")
+
+    def wait_until_finished(self) -> None:
+        """Block until every in-flight async save has landed; the span
+        makes checkpoint IO that outlives its training overlap show up in
+        the trace (the ``checkpoint`` span only ever covered initiation)."""
+        with self.telemetry.span(
+            "checkpoint_wait", pending=len(self._pending)
+        ):
+            self.manager.wait_until_finished()
+        finished, self._pending = self._pending, []
+        self._observe_completion(finished)
 
     def save_as_only(self, step: int, state: Any) -> None:
         """Replace whatever checkpoints exist with this one. The best-
@@ -99,11 +138,15 @@ class Checkpointer:
             with open(tmp, "w") as f:
                 json.dump({"step": int(step)}, f)
             os.replace(tmp, marker)
+        t0 = time.monotonic()
         with self.telemetry.span("checkpoint", step=step, best=True):
             self.manager.save(
                 step, args=ocp.args.StandardSave(state), force=True
             )
             self.manager.wait_until_finished()
+        # the awaited save above also drains any older pending saves
+        finished, self._pending = self._pending, []
+        self._observe_completion(finished + [(step, t0)])
         self.telemetry.count("checkpoint/saves")
         for s in self.manager.all_steps():
             if s != step:
@@ -130,7 +173,7 @@ class Checkpointer:
             )
 
     def close(self) -> None:
-        self.manager.wait_until_finished()
+        self.wait_until_finished()
         self.manager.close()
 
 

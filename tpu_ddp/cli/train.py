@@ -70,6 +70,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "pp (GPipe pipeline), sp (sequence parallel + ring "
                         "attention), ep (expert parallel MoE). Default: "
                         "inferred from --mesh, else dp")
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1 weight-update sharding (dp/sp): reduce-"
+                        "scatter gradients instead of all-reducing them, "
+                        "apply the optimizer to only this replica's 1/N "
+                        "shard of params + optimizer state (the state "
+                        "lives scattered — ~1/N the optimizer HBM and "
+                        "update FLOPs), then all-gather the updated "
+                        "params. Identical training math; checkpoints "
+                        "stay in the replicated layout so --resume "
+                        "composes in either direction")
     p.add_argument("--mesh", default=None, metavar="AXES",
                    help="device mesh axis sizes, e.g. data=2,model=4 "
                         "(axes: data, pipeline, expert, sequence, model; "
@@ -277,11 +287,12 @@ def config_from_args(args) -> TrainConfig:
                 "TPU. Check the TPU runtime, or pass --device cpu/auto."
             )
     if args.compilation_cache_dir:
-        jax.config.update("jax_compilation_cache_dir",
-                          args.compilation_cache_dir)
-        # cache even fast compiles: the CLI's models recompile identically
-        # run over run, so any hit is pure win
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # applied HERE as well as at Trainer construction: nothing between
+        # argument parsing and the Trainer may trigger a trace, and the
+        # cache config must precede the first compile either way
+        from tpu_ddp.train.trainer import apply_compilation_cache
+
+        apply_compilation_cache(args.compilation_cache_dir)
     n_devices = args.n_devices
     per_shard = args.batch_size
     mesh_sizes = None if args.mesh is None else parse_mesh_arg(args.mesh)
@@ -327,6 +338,7 @@ def config_from_args(args) -> TrainConfig:
         ema_decay=args.ema_decay,
         n_devices=n_devices,
         parallelism=args.parallelism,
+        zero1=args.zero1,
         mesh=mesh_sizes,
         n_microbatches=args.microbatches,
         pp_schedule=args.pp_schedule,
@@ -356,6 +368,7 @@ def config_from_args(args) -> TrainConfig:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every_epochs=args.checkpoint_every_epochs,
         resume=args.resume,
+        compilation_cache_dir=args.compilation_cache_dir,
         keep_best=args.keep_best,
         jsonl_path=args.jsonl,
         tensorboard_dir=args.tensorboard_dir,

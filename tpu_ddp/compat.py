@@ -63,6 +63,55 @@ if not hasattr(jax, "shard_map"):
     except Exception:  # pallas internals moved: leave the rule unregistered
         pass
 
+    # 0.4.x types all_gather as varying -> varying (the generic collective
+    # rule), so an out_spec claiming replication of a gathered value fails
+    # the rep check — but a tiled all_gather over an axis RETURNS THE SAME
+    # GLOBAL ARRAY ON EVERY SHARD of that axis by construction, i.e. its
+    # output is genuinely replicated over the gathered axis. The ZeRO-1
+    # update sharding (parallel/zero.py) leans on exactly this: params are
+    # all-gathered back from per-shard updates and leave the shard_map as
+    # P() (replicated). Upgrade the check + rewrite rules to the precise
+    # typing (axis_index_groups gathers only within a group, where the
+    # claim would be false — those keep the conservative rule).
+    try:
+        from jax._src.lax import parallel as _lax_parallel
+        from jax.experimental import shard_map as _smod
+
+        def _all_gather_check(mesh, x_rep, *, all_gather_dimension,
+                              axis_name, axis_index_groups, axis_size,
+                              tiled):
+            del mesh, all_gather_dimension, axis_size, tiled
+            names = (axis_name if isinstance(axis_name, tuple)
+                     else (axis_name,))
+            if axis_index_groups is not None or x_rep is None:
+                return x_rep
+            return x_rep | set(names)
+
+        def _all_gather_rewrite(mesh, in_rep, x, *, all_gather_dimension,
+                                axis_name, axis_index_groups, axis_size,
+                                tiled):
+            del mesh
+            names = (axis_name if isinstance(axis_name, tuple)
+                     else (axis_name,))
+            (x_rep,) = in_rep
+            pb = set(names) & x_rep
+            if pb:  # standard rewrite: inputs already replicated get a
+                    # (numerically identity) pbroadcast to re-type varying
+                x = _smod.pbroadcast(x, tuple(pb))
+            out = _lax_parallel.all_gather_p.bind(
+                x, all_gather_dimension=all_gather_dimension,
+                axis_name=axis_name, axis_index_groups=axis_index_groups,
+                axis_size=axis_size, tiled=tiled,
+            )
+            if axis_index_groups is not None:
+                return [out], [x_rep - set(names)]
+            return [out], [x_rep | set(names)]
+
+        _smod._check_rules[_lax_parallel.all_gather_p] = _all_gather_check
+        _smod._rewrite_rules[_lax_parallel.all_gather_p] = _all_gather_rewrite
+    except Exception:  # parallel internals moved: keep the stock rule
+        pass
+
     # 0.4.x's cond CHECK rule raises when branches infer different
     # replication sets; its own REWRITE rule already unifies them by
     # intersection (`map(op.and_, ...)`) — the check was just stricter

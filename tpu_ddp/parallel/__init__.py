@@ -48,6 +48,8 @@ _LAZY = {
     "to_pipeline_params": "pipeline",
     "MOE_EP_RULES": "expert_parallel",
     "make_ep_train_step": "expert_parallel",
+    "Zero1Partition": "zero",
+    "clip_by_global_norm_sharded": "zero",
 }
 
 
@@ -92,5 +94,7 @@ __all__ = [
     "make_pp_train_step",
     "to_pipeline_params",
     "MOE_EP_RULES",
+    "Zero1Partition",
+    "clip_by_global_norm_sharded",
     "make_ep_train_step",
 ]

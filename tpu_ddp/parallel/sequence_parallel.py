@@ -43,12 +43,19 @@ def make_sp_train_step(
     loss_fn: Callable = cross_entropy_loss,
     donate: bool = True,
     health: Optional[HealthConfig] = None,
+    zero1=None,
 ):
     """Compiled train step for an SP-aware model (ViT with sp_axis=seq_axis).
 
     Batch layout: {image (N, H, W, C), label (N,), mask (N,)} — image sharded
     (data, sequence) on (N, H); labels/mask sharded on data only. H must be
     divisible by patch_size * mesh.shape[seq_axis].
+
+    ``zero1`` (``tpu_ddp.parallel.zero.Zero1Partition``): the DATA half of
+    the gradient sync becomes a reduce-scatter and the optimizer state
+    scatters over ``data`` (replicated over ``sequence`` — the update space
+    partitions over the DP axis only); the sequence-axis collective for the
+    distributed attention partials is unchanged.
     """
 
     def compute_loss(params, batch):
@@ -60,32 +67,53 @@ def make_sp_train_step(
         # varying-axes tracking inserts the correct sequence-axis psums for
         # the distributed attention partials during the transpose. SHIMMED
         # jax: both collectives move to the explicit grad sync below.
-        return lax.pmean(loss, data_axis) if GRAD_SYNC_IN_AD else loss
+        # zero1: the data sync is the reduce-scatter — the loss stays local.
+        if GRAD_SYNC_IN_AD and zero1 is None:
+            return lax.pmean(loss, data_axis)
+        return loss
 
     def shard_step(state: TrainState, batch):
-        loss, grads = jax.value_and_grad(compute_loss)(state.params, batch)
+        p_in = (zero1.varying(state.params) if zero1 is not None
+                else state.params)
+        loss, grads = jax.value_and_grad(compute_loss)(p_in, batch)
         if not GRAD_SYNC_IN_AD:
             # On old jax, psum transposes to psum: the n_seq identical
             # replicated-loss seeds re-sum through the model's pooling
             # psum, so every partial arrives n_seq-fold — pmean (not
             # psum) over the ring both sums the per-shard partials and
-            # cancels that factor; then DDP-average over data.
-            grads = jax.tree.map(
-                lambda g: lax.pmean(lax.pmean(g, seq_axis), data_axis),
-                grads,
-            )
+            # cancels that factor; then DDP-average over data (zero1:
+            # over data the average moves into the reduce-scatter).
+            seq_done = jax.tree.map(
+                lambda g: lax.pmean(g, seq_axis), grads)
+            grads = (seq_done if zero1 is not None else jax.tree.map(
+                lambda g: lax.pmean(g, data_axis), seq_done))
             loss = lax.pmean(loss, data_axis)
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        elif zero1 is not None:
+            loss = lax.pmean(loss, data_axis)
+        if zero1 is not None:
+            new_params, new_opt_state, gshards, ushards = (
+                zero1.sharded_update(grads, state.params, state.opt_state)
+            )
+        else:
+            updates, new_opt_state = tx.update(
+                grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss}
         if health is not None:
             # grads are synced over BOTH mesh axes by this point (either
-            # sync mode), so the in-graph stats are true globals on every
-            # (data, seq) shard — same schema as the DP step
-            hstats = health_stats(
-                loss=loss, grads=grads, params=state.params,
-                updates=updates, per_layer=health.per_layer,
-            )
+            # sync mode; zero1's shards are seq-complete and data-
+            # scattered, psum'd back to globals inside health_stats), so
+            # the stats are true globals — same schema as the DP step
+            if zero1 is not None:
+                hstats = zero1.health_stats(
+                    loss=loss, grad_shards=gshards, params=state.params,
+                    update_shards=ushards, per_layer=health.per_layer,
+                )
+            else:
+                hstats = health_stats(
+                    loss=loss, grads=grads, params=state.params,
+                    updates=updates, per_layer=health.per_layer,
+                )
             new_params, new_opt_state = guard_step(
                 health, hstats, (new_params, new_opt_state),
                 (state.params, state.opt_state),
@@ -103,10 +131,11 @@ def make_sp_train_step(
         "label": P(data_axis),
         "mask": P(data_axis),
     }
+    state_specs = zero1.state_specs() if zero1 is not None else P()
     sharded = jax.shard_map(
         shard_step,
         mesh=mesh,
-        in_specs=(P(), batch_specs),
-        out_specs=(P(), P()),
+        in_specs=(state_specs, batch_specs),
+        out_specs=(state_specs, P()),
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())

@@ -51,7 +51,7 @@ def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
          image_size: int | None = None,
          num_classes: int | None = None,
          parallelism: str = "dp", axis_size: int | None = None,
-         grad_accum_steps: int = 1) -> dict:
+         grad_accum_steps: int = 1, zero1: bool = False) -> dict:
     """Compile the DP train step for ``topology`` and return the memory
     report dict. Raises on compile failure (a real regression).
 
@@ -87,6 +87,7 @@ def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
             momentum=momentum, ema_decay=ema_decay, image_size=image_size,
             num_classes=num_classes, parallelism=parallelism,
             axis_size=axis_size, grad_accum_steps=grad_accum_steps,
+            zero1=zero1,
         )
     finally:
         jax.config.update("jax_platforms", prev_platforms)
@@ -94,7 +95,8 @@ def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
 
 def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
                 topology, n_devices, momentum, ema_decay, image_size,
-                num_classes, parallelism, axis_size, grad_accum_steps=1):
+                num_classes, parallelism, axis_size, grad_accum_steps=1,
+                zero1=False):
     import jax
 
     import jax.numpy as jnp
@@ -143,9 +145,16 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
     else:
         model = MODEL_REGISTRY[model_name](num_classes=num_classes,
                                            dtype=dtype)
+    if zero1 and parallelism != "dp":
+        raise ValueError(
+            "--zero1 plans the DP weight-update-sharding layout; "
+            f"--parallelism {parallelism} owns its own state layout "
+            "(fsdp IS ZeRO-3)"
+        )
     # ema_decay matters here exactly like momentum: each is a full
     # param-sized optimizer-state tree of HBM the plan must count
-    tx = make_optimizer(lr=1e-1, momentum=momentum, ema_decay=ema_decay)
+    tx = make_optimizer(lr=1e-1, momentum=momentum, ema_decay=ema_decay,
+                        zero1_axis="data" if zero1 else None)
     state = jax.eval_shape(
         lambda: create_train_state(
             model, tx, jax.random.key(0),
@@ -158,14 +167,37 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
             f"--parallelism {parallelism} (pp schedules microbatches "
             "itself; sp's ring step owns its memory story)"
         )
+    zero1_report = None
     if parallelism == "dp":
+        part = None
+        if zero1:
+            # ZeRO-1: abstract state carries the FLAT opt leaves scattered
+            # over data — the compiler's per-device argument_bytes then
+            # shows the 1/N optimizer-state shrink as ground truth, next
+            # to the layout's own static accounting below.
+            from tpu_ddp.parallel.partitioning import abstract_train_state
+            from tpu_ddp.parallel.zero import Zero1Partition
+
+            part = Zero1Partition(tx, state.params, mesh.shape["data"])
+            state = state.replace(opt_state=part.opt_template)
+            state = abstract_train_state(
+                state, part.state_shardings(state, mesh))
+            acct = part.accounting()
+            param_bytes = sum(
+                int(jnp.prod(jnp.asarray(p.shape or (1,))))
+                * jnp.dtype(p.dtype).itemsize
+                for p in jax.tree.leaves(state.params)
+            )
+            acct["params_bytes_per_device"] = param_bytes  # replicated
+            zero1_report = acct
         if grad_accum_steps > 1:
             from tpu_ddp.train.steps import make_grad_accum_train_step
 
             step = make_grad_accum_train_step(
-                model, tx, mesh, accum_steps=grad_accum_steps, remat=remat)
+                model, tx, mesh, accum_steps=grad_accum_steps, remat=remat,
+                zero1=part)
         else:
-            step = make_train_step(model, tx, mesh, remat=remat)
+            step = make_train_step(model, tx, mesh, remat=remat, zero1=part)
     else:
         step, state = _build_sharded(parallelism, model, tx, mesh, state,
                                      axis_size, image_size, remat=remat,
@@ -190,9 +222,11 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
     # Steady state: donated inputs alias outputs, so peak is roughly
     # args + temp (the compiler's temp already includes the working set).
     peak = arg + temp
+    report_parallelism = "dp+zero1" if zero1 else parallelism
     return {
         "model": model_name,
-        "parallelism": parallelism,
+        "parallelism": report_parallelism,
+        "zero1": zero1_report,
         "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
         "image_size": image_size,
         "num_classes": num_classes,
@@ -339,6 +373,13 @@ def main(argv=None) -> dict:
                    help="fsdp = ZeRO-3 state scatter (argument_bytes shows "
                         "the 1/N shrink); tp/fsdp_tp/pp/ep/sp plan the "
                         "sharded layouts on a data x axis mesh")
+    p.add_argument("--zero1", action="store_true",
+                   help="plan the DP step with ZeRO-1 weight-update "
+                        "sharding: the report gains a 'zero1' section "
+                        "with replicated vs per-device-sharded optimizer-"
+                        "state bytes (static accounting), and the "
+                        "compiler's argument_bytes confirms the 1/N "
+                        "shrink — run with and without to diff")
     p.add_argument("--axis-size", type=int, default=None,
                    help="size of the non-data mesh axis for "
                         "tp/fsdp_tp/pp/ep/sp (default: 2 for pp — vit_s4 "
@@ -365,6 +406,7 @@ def main(argv=None) -> dict:
         image_size=args.image_size,
         num_classes=args.num_classes, parallelism=args.parallelism,
         axis_size=args.axis_size, grad_accum_steps=args.grad_accum_steps,
+        zero1=args.zero1,
     )
     print(json.dumps(report, indent=1))
     if report["fits"] is False:

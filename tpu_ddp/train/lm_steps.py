@@ -63,8 +63,13 @@ def make_lm_train_step(
     data_axis: str = DATA_AXIS,
     donate: bool = True,
     health: Optional[HealthConfig] = None,
+    zero1=None,
 ) -> Callable:
-    """step(state, {"tokens": (B, T) int32}) -> (state, {"loss"})."""
+    """step(state, {"tokens": (B, T) int32}) -> (state, {"loss"}).
+
+    ``zero1`` (Zero1Partition): ZeRO-1 weight-update sharding — the grad
+    pmean becomes a reduce-scatter and the optimizer state lives scattered
+    over ``data_axis`` (parallel/zero.py)."""
 
     def shard_step(state: TrainState, batch):
         tokens = batch["tokens"]
@@ -75,32 +80,55 @@ def make_lm_train_step(
             # pmean BEFORE differentiation: AD of the averaged loss emits
             # the cross-shard grad psum (the DDP semantics, exactly as in
             # train/steps.py). SHIMMED jax: sync moves to the explicit
-            # grad pmean below.
-            return lax.pmean(loss, data_axis) if GRAD_SYNC_IN_AD else loss
+            # grad pmean below. zero1: the sync is the reduce-scatter —
+            # the loss stays local in both modes.
+            if GRAD_SYNC_IN_AD and zero1 is None:
+                return lax.pmean(loss, data_axis)
+            return loss
 
-        loss, grads = jax.value_and_grad(compute_loss)(state.params)
-        if not GRAD_SYNC_IN_AD:
-            grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
+        p_in = zero1.varying(state.params) if zero1 is not None else state.params
+        loss, grads = jax.value_and_grad(compute_loss)(p_in)
+        if not GRAD_SYNC_IN_AD or zero1 is not None:
             loss = lax.pmean(loss, data_axis)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if zero1 is not None:
+            new_params, new_opt, gshards, ushards = zero1.sharded_update(
+                grads, state.params, state.opt_state
+            )
+        else:
+            if not GRAD_SYNC_IN_AD:
+                grads = jax.tree.map(
+                    lambda g: lax.pmean(g, data_axis), grads)
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss}
         if health is not None:
-            metrics["health"], new_params, new_opt = _with_health(
-                health, loss=loss, grads=grads, params=state.params,
-                updates=updates, new_params=new_params,
-                new_opt_state=new_opt, old_opt_state=state.opt_state,
-            )
+            if zero1 is not None:
+                hstats = zero1.health_stats(
+                    loss=loss, grad_shards=gshards, params=state.params,
+                    update_shards=ushards, per_layer=health.per_layer,
+                )
+                new_params, new_opt = guard_step(
+                    health, hstats, (new_params, new_opt),
+                    (state.params, state.opt_state),
+                )
+                metrics["health"] = hstats
+            else:
+                metrics["health"], new_params, new_opt = _with_health(
+                    health, loss=loss, grads=grads, params=state.params,
+                    updates=updates, new_params=new_params,
+                    new_opt_state=new_opt, old_opt_state=state.opt_state,
+                )
         return (
             state.replace(step=state.step + 1, params=new_params,
                           opt_state=new_opt),
             metrics,
         )
 
+    state_specs = zero1.state_specs() if zero1 is not None else P()
     sharded = jax.shard_map(
         shard_step, mesh=mesh,
-        in_specs=(P(), {"tokens": P(data_axis)}),
-        out_specs=(P(), P()),
+        in_specs=(state_specs, {"tokens": P(data_axis)}),
+        out_specs=(state_specs, P()),
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
@@ -114,9 +142,16 @@ def make_sp_lm_train_step(
     seq_axis: str = SEQUENCE_AXIS,
     donate: bool = True,
     health: Optional[HealthConfig] = None,
+    zero1=None,
 ) -> Callable:
     """Sequence-parallel next-token step. ``model`` must be built with
-    ``sp_axis=seq_axis``; tokens arrive (B_local, T_local) per shard."""
+    ``sp_axis=seq_axis``; tokens arrive (B_local, T_local) per shard.
+
+    ``zero1``: the data-axis half of the gradient sync becomes a
+    reduce-scatter and the optimizer state scatters over ``data`` (it
+    stays REPLICATED over ``sequence`` — the update space is partitioned
+    over the DP axis only, parallel/zero.py). The sequence-axis psum of
+    the attention partials is unchanged."""
     n_seq = mesh.shape[seq_axis]
     shift_perm = [(i, (i - 1) % n_seq) for i in range(n_seq)]
 
@@ -142,44 +177,69 @@ def make_sp_lm_train_step(
             # (B, T-1); then DDP-average over data
             loss = loss_sum / count  # already seq-invariant (psum above)
             if GRAD_SYNC_IN_AD:
-                return lax.pmean(loss, data_axis)
+                # zero1: keep the loss data-LOCAL (the reduce-scatter is
+                # the data-axis sync); seq invariance already holds
+                return loss if zero1 is not None else lax.pmean(
+                    loss, data_axis)
             # SHIMMED: old jax transposes the loss_sum psum back to a psum,
             # so the n_seq identical per-shard loss seeds re-sum into an
             # n_seq over-count of every cotangent; pre-scaling the
             # differentiated value cancels it (the metric is rescaled below)
             return loss / n_seq
 
-        loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        p_in = zero1.varying(state.params) if zero1 is not None else state.params
+        loss, grads = jax.value_and_grad(compute_loss)(p_in)
         if not GRAD_SYNC_IN_AD:
             # each (data, seq) shard's AD yields its local partial of the
             # replicated params' gradient: sum the partials over the
-            # sequence ring, then DDP-average over data
-            grads = jax.tree.map(
-                lambda g: lax.pmean(lax.psum(g, seq_axis), data_axis), grads
-            )
+            # sequence ring, then DDP-average over data (zero1: the data
+            # half of the sync moves into the reduce-scatter below)
+            seq_sync = (lax.psum if zero1 is not None else
+                        lambda g, ax: lax.pmean(lax.psum(g, ax), data_axis))
+            grads = jax.tree.map(lambda g: seq_sync(g, seq_axis), grads)
             loss = lax.pmean(loss * n_seq, data_axis)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        elif zero1 is not None:
+            loss = lax.pmean(loss, data_axis)
+        if zero1 is not None:
+            new_params, new_opt, gshards, ushards = zero1.sharded_update(
+                grads, state.params, state.opt_state
+            )
+        else:
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss}
         if health is not None:
             # grads are fully synced over BOTH axes at this point (AD of
-            # the psum'd/pmean'd loss, or the explicit pmean-of-psum
-            # above), so the stats are (data x seq)-replicated globals
-            metrics["health"], new_params, new_opt = _with_health(
-                health, loss=loss, grads=grads, params=state.params,
-                updates=updates, new_params=new_params,
-                new_opt_state=new_opt, old_opt_state=state.opt_state,
-            )
+            # the psum'd/pmean'd loss, the explicit pmean-of-psum above,
+            # or the zero1 shards — seq-complete, data-scattered), so the
+            # stats are (data x seq)-replicated globals
+            if zero1 is not None:
+                hstats = zero1.health_stats(
+                    loss=loss, grad_shards=gshards, params=state.params,
+                    update_shards=ushards, per_layer=health.per_layer,
+                )
+                new_params, new_opt = guard_step(
+                    health, hstats, (new_params, new_opt),
+                    (state.params, state.opt_state),
+                )
+                metrics["health"] = hstats
+            else:
+                metrics["health"], new_params, new_opt = _with_health(
+                    health, loss=loss, grads=grads, params=state.params,
+                    updates=updates, new_params=new_params,
+                    new_opt_state=new_opt, old_opt_state=state.opt_state,
+                )
         return (
             state.replace(step=state.step + 1, params=new_params,
                           opt_state=new_opt),
             metrics,
         )
 
+    state_specs = zero1.state_specs() if zero1 is not None else P()
     sharded = jax.shard_map(
         shard_step, mesh=mesh,
-        in_specs=(P(), {"tokens": P(data_axis, seq_axis)}),
-        out_specs=(P(), P()),
+        in_specs=(state_specs, {"tokens": P(data_axis, seq_axis)}),
+        out_specs=(state_specs, P()),
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 

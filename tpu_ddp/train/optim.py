@@ -93,6 +93,8 @@ def make_optimizer(
     freeze_predicate: Optional[Callable[[tuple, object], bool]] = None,
     optimizer: str = "sgd",
     ema_decay: float = 0.0,
+    decay_mask: Optional[Any] = None,
+    zero1_axis: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """freeze_predicate(path_tuple, leaf) -> True to FREEZE that param.
     ``grad_clip_norm`` > 0 clips the GLOBAL gradient norm before the update
@@ -109,9 +111,32 @@ def make_optimizer(
     ``ema_decay`` > 0 maintains an exponential moving average of the
     params inside opt_state (`EmaState`); the Trainer evaluates with the
     averaged weights when enabled (``find_ema``) — the standard
-    late-training variance reduction the reference has no analogue for."""
+    late-training variance reduction the reference has no analogue for.
+
+    ``zero1_axis`` builds the optimizer for the ZeRO-1 sharded update
+    space (parallel/zero.py): the transform chain then runs on per-leaf
+    1/N SHARDS inside the shard_map, so (a) global-norm clipping switches
+    to the psum-over-axis variant, and (b) the kernels-only decay mask
+    must be PRECOMPUTED on the original-shaped params and passed as
+    ``decay_mask`` (a per-leaf bool pytree — ndim is meaningless on the
+    flattened leaves). lamb is rejected: its per-LAYER trust ratios need
+    whole-leaf norms that a 1/N slice cannot provide. Everything else in
+    the chain is elementwise and shards exactly."""
     if grad_clip_norm < 0:
         raise ValueError(f"grad_clip_norm must be >= 0, got {grad_clip_norm}")
+    if zero1_axis is not None and optimizer == "lamb":
+        raise ValueError(
+            "--zero1 does not compose with --optimizer lamb: the "
+            "layer-wise trust ratio needs whole-parameter norms, which "
+            "the 1/N update shards cannot provide"
+        )
+    if zero1_axis is not None and weight_decay > 0 and decay_mask is None:
+        raise ValueError(
+            "zero1_axis with weight_decay needs a precomputed decay_mask "
+            "pytree (the ndim>=2 heuristic cannot see original shapes on "
+            "flattened update-space leaves)"
+        )
+    mask = decay_mask if decay_mask is not None else _decay_mask
     if schedule == "cosine":
         assert total_steps, "cosine schedule needs total_steps"
         lr_sched = optax.warmup_cosine_decay_schedule(
@@ -127,7 +152,7 @@ def make_optimizer(
         if weight_decay > 0:
             tx = optax.chain(
                 optax.masked(
-                    optax.add_decayed_weights(weight_decay), _decay_mask
+                    optax.add_decayed_weights(weight_decay), mask
                 ),
                 tx,
             )
@@ -138,14 +163,23 @@ def make_optimizer(
                 "moment estimates (b1=0.9)"
             )
         factory = optax.adamw if optimizer == "adamw" else optax.lamb
-        tx = factory(lr_sched, weight_decay=weight_decay, mask=_decay_mask)
+        tx = factory(lr_sched, weight_decay=weight_decay, mask=mask)
     else:
         raise ValueError(f"unknown optimizer {optimizer!r}")
     if grad_clip_norm > 0:
         # Outermost: the clip sees the RAW (synchronized) gradient; the
         # weight-decay term (coupled: added pre-lr, so effective decay is
-        # lr*wd) is applied inside the clip, not subject to it.
-        tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
+        # lr*wd) is applied inside the clip, not subject to it. In the
+        # zero1 update space the "global" norm lives scattered — the
+        # sharded variant psums the squared partials over the axis first.
+        if zero1_axis is not None:
+            from tpu_ddp.parallel.zero import clip_by_global_norm_sharded
+
+            tx = optax.chain(
+                clip_by_global_norm_sharded(grad_clip_norm, zero1_axis), tx
+            )
+        else:
+            tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
 
     if freeze_predicate is not None:
         import jax

@@ -22,10 +22,17 @@ class TrainState(struct.PyTreeNode):
     opt_state: Any
 
 
-def create_train_state(model, tx, rng, input_shape=(1, 32, 32, 3)) -> TrainState:
+def init_model_variables(model, rng, input_shape=(1, 32, 32, 3)) -> tuple:
+    """(params, batch_stats) from a dummy-input init — THE init recipe,
+    shared by ``create_train_state`` and the ZeRO-1 path (which must defer
+    ``tx.init`` so the optimizer state is born scattered; seed-parity
+    between the two paths depends on this being one function)."""
     variables = model.init(rng, jnp.zeros(input_shape, jnp.float32), train=False)
-    params = variables["params"]
-    batch_stats = variables.get("batch_stats", {})
+    return variables["params"], variables.get("batch_stats", {})
+
+
+def create_train_state(model, tx, rng, input_shape=(1, 32, 32, 3)) -> TrainState:
+    params, batch_stats = init_model_variables(model, rng, input_shape)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,

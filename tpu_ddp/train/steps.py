@@ -91,9 +91,18 @@ def _make_shard_step(
     mixup_alpha: float = 0.0,
     aux_weight: float = 0.01,
     health: Optional[HealthConfig] = None,
+    zero1=None,
 ):
     """Per-shard train-step body shared by the single-step and scanned
     variants: forward, pmean'd loss (the gradient allreduce), optax update.
+
+    ``zero1`` (a ``tpu_ddp.parallel.zero.Zero1Partition``) swaps the
+    replicated update for ZeRO-1 weight-update sharding: the grad pmean
+    becomes a reduce-scatter, the optimizer touches only this shard's 1/N
+    slice of params + optimizer state (opt state enters/leaves the step
+    scattered over the data axis), and the updated params are all-gathered
+    back to replicated — mathematically identical, 1/N the optimizer HBM
+    and update FLOPs (parallel/zero.py).
 
     ``health`` compiles the numerics flight recorder into the step (see
     ``tpu_ddp.health.stats``): a ``metrics["health"]`` dict of global
@@ -144,7 +153,10 @@ def _make_shard_step(
         # overlap. (An explicit post-hoc pmean on grads would then DOUBLE-
         # count: AD has already summed.) On SHIMMED jax the sync is instead
         # the explicit grad pmean in shard_step — see GRAD_SYNC_IN_AD.
-        if GRAD_SYNC_IN_AD:
+        # Under zero1 the sync is the reduce-scatter in sharded_update, so
+        # the loss must stay LOCAL in both modes (modern jax differentiates
+        # w.r.t. pcast-varying params instead — zero1.varying below).
+        if GRAD_SYNC_IN_AD and zero1 is None:
             loss = lax.pmean(loss, data_axis)
         return loss, (mutated.get("batch_stats", batch_stats), logits, task, aux)
 
@@ -169,27 +181,48 @@ def _make_shard_step(
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
         # named scopes label the XLA ops so a jax.profiler device trace
         # (and the telemetry Chrome trace next to it) read the same phases
+        p_in = zero1.varying(state.params) if zero1 is not None else state.params
         with jax.named_scope("tpu_ddp.forward_backward"):
             (_, (new_stats, logits, task, aux)), grads = grad_fn(
-                state.params, state.batch_stats, batch
+                p_in, state.batch_stats, batch
             )
-        if not GRAD_SYNC_IN_AD:
-            grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
         new_stats = jax.tree.map(lambda s: lax.pmean(s, data_axis), new_stats)
-        with jax.named_scope("tpu_ddp.optimizer_update"):
-            updates, new_opt_state = tx.update(
-                grads, state.opt_state, state.params
-            )
-            new_params = optax.apply_updates(state.params, updates)
+        if zero1 is not None:
+            # ZeRO-1: reduce-scatter IS the gradient sync; the optimizer
+            # consumes only this shard's slice of grads/params/opt state
+            # and the updated params come back via one all-gather.
+            with jax.named_scope("tpu_ddp.optimizer_update"):
+                new_params, new_opt_state, gshards, ushards = (
+                    zero1.sharded_update(
+                        grads, state.params, state.opt_state
+                    )
+                )
+        else:
+            if not GRAD_SYNC_IN_AD:
+                grads = jax.tree.map(
+                    lambda g: lax.pmean(g, data_axis), grads)
+            with jax.named_scope("tpu_ddp.optimizer_update"):
+                updates, new_opt_state = tx.update(
+                    grads, state.opt_state, state.params
+                )
+                new_params = optax.apply_updates(state.params, updates)
         if health is not None:
-            # grads are the synchronized values here in BOTH sync modes
-            # (AD-of-pmean'd-loss, or the explicit pmean above), so every
+            # grads/updates are the synchronized values in EVERY sync mode
+            # (AD-of-pmean'd-loss, the explicit pmean, or the zero1 shards
+            # whose shard-local norms are psum'd over data), so every
             # shard computes identical global stats in-graph.
-            hstats = health_stats(
-                loss=lax.pmean(task, data_axis), grads=grads,
-                params=state.params, updates=updates,
-                per_layer=health.per_layer,
-            )
+            if zero1 is not None:
+                hstats = zero1.health_stats(
+                    loss=lax.pmean(task, data_axis), grad_shards=gshards,
+                    params=state.params, update_shards=ushards,
+                    per_layer=health.per_layer,
+                )
+            else:
+                hstats = health_stats(
+                    loss=lax.pmean(task, data_axis), grads=grads,
+                    params=state.params, updates=updates,
+                    per_layer=health.per_layer,
+                )
             new_params, new_stats, new_opt_state = guard_step(
                 health, hstats,
                 (new_params, new_stats, new_opt_state),
@@ -233,6 +266,7 @@ def make_train_step(
     mixup_alpha: float = 0.0,
     aux_weight: float = 0.01,
     health: Optional[HealthConfig] = None,
+    zero1=None,
 ) -> Callable[[TrainState, Batch], tuple]:
     """Build the compiled DDP train step for `mesh`.
 
@@ -244,6 +278,8 @@ def make_train_step(
     ``augment=True`` applies on-device random crop+flip to the shard's images
     (keyed by step and shard index — reproducible across resume, distinct
     per device; the recipe extension the reference lacks, SURVEY.md §7.3).
+    ``zero1`` (Zero1Partition) runs the ZeRO-1 sharded weight update; the
+    state's opt leaves then enter/leave scattered over ``data_axis``.
     """
     shard_step = _make_shard_step(
         model,
@@ -257,12 +293,14 @@ def make_train_step(
         mixup_alpha=mixup_alpha,
         aux_weight=aux_weight,
         health=health,
+        zero1=zero1,
     )
+    state_specs = zero1.state_specs() if zero1 is not None else P()
     sharded = jax.shard_map(
         shard_step,
         mesh=mesh,
-        in_specs=(P(), P(data_axis)),
-        out_specs=(P(), P()),
+        in_specs=(state_specs, P(data_axis)),
+        out_specs=(state_specs, P()),
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
@@ -283,6 +321,7 @@ def make_scan_train_step(
     mixup_alpha: float = 0.0,
     aux_weight: float = 0.01,
     health: Optional[HealthConfig] = None,
+    zero1=None,
 ) -> Callable[[TrainState, Batch], tuple]:
     """K train steps fused into ONE dispatch via ``lax.scan``.
 
@@ -297,6 +336,12 @@ def make_scan_train_step(
     has shape (K, global_batch, ...) sharded over ``data_axis`` on axis 1,
     and every metric leaf gains a leading (K,) axis (per-step losses, in
     order — the trainer logs them exactly as if stepped one by one).
+
+    Under ``zero1`` the scattered optimizer state rides the scan carry
+    UNGATHERED: the K inner steps each reduce-scatter fresh grads, update
+    their shard, and all-gather only the params (once per inner step, for
+    the next forward/backward) — the shard state never re-replicates
+    inside the fused dispatch.
     """
     shard_step = _make_shard_step(
         model,
@@ -310,16 +355,18 @@ def make_scan_train_step(
         mixup_alpha=mixup_alpha,
         aux_weight=aux_weight,
         health=health,
+        zero1=zero1,
     )
 
     def shard_multi(state: TrainState, batches: Batch):
         return lax.scan(shard_step, state, batches, length=steps_per_call)
 
+    state_specs = zero1.state_specs() if zero1 is not None else P()
     sharded = jax.shard_map(
         shard_multi,
         mesh=mesh,
-        in_specs=(P(), P(None, data_axis)),
-        out_specs=(P(), P()),
+        in_specs=(state_specs, P(None, data_axis)),
+        out_specs=(state_specs, P()),
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 
@@ -337,6 +384,7 @@ def make_grad_accum_train_step(
     remat: bool = False,
     aux_weight: float = 0.01,
     health: Optional[HealthConfig] = None,
+    zero1=None,
 ) -> Callable[[TrainState, Batch], tuple]:
     """ONE optimizer step over a global batch too large to activate at
     once: each shard splits its rows into ``accum_steps`` microbatches,
@@ -378,7 +426,9 @@ def make_grad_accum_train_step(
         logits, mutated = apply_model(params, batch_stats, micro["image"])
         task = loss_fn(logits, micro["label"], micro.get("mask"))
         loss, aux = combine_aux_loss(task, mutated, aux_weight)
-        if GRAD_SYNC_IN_AD:  # grad sync, as in _make_shard_step
+        # grad sync, as in _make_shard_step (zero1: the sync is the
+        # reduce-scatter AFTER accumulation — the loss stays local)
+        if GRAD_SYNC_IN_AD and zero1 is None:
             loss = lax.pmean(loss, data_axis)
         return loss, (mutated.get("batch_stats", batch_stats), logits, task, aux)
 
@@ -395,11 +445,12 @@ def make_grad_accum_train_step(
         )
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
         zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+        p_in = zero1.varying(state.params) if zero1 is not None else state.params
 
         def accum(carry, micro):
             grads_acc, stats, correct, count, loss_sum, aux_sum = carry
             (_, (new_stats, logits, task, aux)), grads = grad_fn(
-                state.params, stats, micro
+                p_in, stats, micro
             )
             grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
             c, n = masked_accuracy(logits, micro["label"], micro.get("mask"))
@@ -425,20 +476,36 @@ def make_grad_accum_train_step(
             micros,
         )
         grads = jax.tree.map(lambda g: g / accum_steps, grads_acc)
-        if not GRAD_SYNC_IN_AD:  # see _make_shard_step: explicit sync
-            grads = jax.tree.map(lambda g: lax.pmean(g, data_axis), grads)
         new_stats = jax.tree.map(lambda s: lax.pmean(s, data_axis), new_stats)
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        if zero1 is not None:
+            # ONE reduce-scatter for the whole accumulated batch: the
+            # microbatch mean above commutes with the cross-shard average.
+            new_params, new_opt_state, gshards, ushards = (
+                zero1.sharded_update(grads, state.params, state.opt_state)
+            )
+        else:
+            if not GRAD_SYNC_IN_AD:  # see _make_shard_step: explicit sync
+                grads = jax.tree.map(
+                    lambda g: lax.pmean(g, data_axis), grads)
+            updates, new_opt_state = tx.update(
+                grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
         if health is not None:
             # same guarantees as _make_shard_step: grads/updates are the
             # synchronized values the optimizer consumed (the accumulated
             # average), so the stats are the true full-batch numbers
-            hstats = health_stats(
-                loss=lax.pmean(loss_sum / accum_steps, data_axis),
-                grads=grads, params=state.params, updates=updates,
-                per_layer=health.per_layer,
-            )
+            if zero1 is not None:
+                hstats = zero1.health_stats(
+                    loss=lax.pmean(loss_sum / accum_steps, data_axis),
+                    grad_shards=gshards, params=state.params,
+                    update_shards=ushards, per_layer=health.per_layer,
+                )
+            else:
+                hstats = health_stats(
+                    loss=lax.pmean(loss_sum / accum_steps, data_axis),
+                    grads=grads, params=state.params, updates=updates,
+                    per_layer=health.per_layer,
+                )
             new_params, new_stats, new_opt_state = guard_step(
                 health, hstats,
                 (new_params, new_stats, new_opt_state),
@@ -459,11 +526,12 @@ def make_grad_accum_train_step(
             )
         return new_state, metrics
 
+    state_specs = zero1.state_specs() if zero1 is not None else P()
     sharded = jax.shard_map(
         shard_step,
         mesh=mesh,
-        in_specs=(P(), P(data_axis)),
-        out_specs=(P(), P()),
+        in_specs=(state_specs, P(data_axis)),
+        out_specs=(state_specs, P()),
     )
     return jax.jit(sharded, donate_argnums=(0,) if donate else ())
 

@@ -135,6 +135,9 @@ class Strategy:
     state_shardings: Optional[Any]   # None == fully replicated
     data_size: int                   # mesh.shape['data'] — loader world size
     prepare_eval: Callable = lambda state: state
+    zero1: Optional[Any] = None      # Zero1Partition when --zero1 (dp/sp):
+                                     # the trainer needs it to de-shard the
+                                     # opt state for checkpoints/EMA eval
 
 
 def _batch_shardings(mesh: Mesh, image_spec: P) -> dict:
@@ -249,6 +252,7 @@ def build_strategy(
     remat: bool = False,
     grad_accum_steps: int = 1,
     health=None,
+    zero1: bool = False,
 ) -> Strategy:
     """Build the full strategy for any non-dp mode on a prebuilt mesh. (The
     dp path stays in Trainer: its shard_map step, scan fusion, and
@@ -268,6 +272,12 @@ def build_strategy(
     numerics flight recorder into whichever family's step builder is
     selected — every mode reports the same ``metrics["health"]`` schema
     (docs/health.md).
+
+    ``zero1`` (``--zero1``) turns on ZeRO-1 weight-update sharding for the
+    modes whose optimizer state is otherwise replicated (dp is handled in
+    the Trainer; sp here). The GSPMD family rejects it: fsdp/fsdp_tp
+    already scatter the optimizer state (ZeRO-3 subsumes ZeRO-1), and
+    tp/pp/ep lay their state out by their own partition rules.
     """
     from tpu_ddp.parallel.partitioning import shard_train_state
     from tpu_ddp.train.steps import make_eval_step, make_predict_step
@@ -280,6 +290,13 @@ def build_strategy(
             "--remat/--grad-accum-steps are not supported with "
             f"--parallelism {parallelism} (pp schedules microbatches "
             "itself; sp's ring step owns its memory story)"
+        )
+    if zero1 and parallelism not in ("dp", "sp"):
+        raise ValueError(
+            f"--zero1 is not supported with --parallelism {parallelism}: "
+            "fsdp/fsdp_tp already scatter the optimizer state (ZeRO-3 "
+            "subsumes ZeRO-1), and tp/pp/ep own their state layout. Use "
+            "--zero1 with dp or sp."
         )
 
     if parallelism == "sp":
@@ -294,9 +311,18 @@ def build_strategy(
         # axis even to trace (ring position indexing), but its param shapes
         # are identical by construction (models/vit.py docstring).
         state = initial_state or create_train_state(plain, tx, rng)
-        state = jax.device_put(state, replicated)
+        part = None
+        state_shardings = None
+        if zero1:
+            from tpu_ddp.parallel.zero import Zero1Partition
+
+            part = Zero1Partition(tx, state.params, data_size, axis=DATA_AXIS)
+            state = part.shard_state(state, mesh)
+            state_shardings = part.state_shardings(state, mesh)
+        else:
+            state = jax.device_put(state, replicated)
         step = make_sp_train_step(
-            sp_model, tx, mesh, loss_fn=loss_fn, health=health)
+            sp_model, tx, mesh, loss_fn=loss_fn, health=health, zero1=part)
         # Eval/predict also run the plain module: attention math is the
         # same, so the standard shard_map eval replicates over the sequence
         # axis and stays exact.
@@ -309,8 +335,9 @@ def build_strategy(
             batch_shardings=_batch_shardings(
                 mesh, P(DATA_AXIS, SEQUENCE_AXIS)
             ),
-            state_shardings=None,
+            state_shardings=state_shardings,
             data_size=data_size,
+            zero1=part,
         )
 
     if parallelism == "pp":

@@ -34,6 +34,29 @@ from tpu_ddp.train.steps import make_eval_step, make_train_step
 log = logging.getLogger(__name__)
 
 
+def apply_compilation_cache(cache_dir: str) -> None:
+    """Enable the persistent XLA compilation cache. Must run before the
+    first trace/compile (the Trainer applies it at construction, ahead of
+    any step build). The 1s floor caches even fast compiles: the CLI's
+    models recompile identically run over run, so any hit is pure win.
+    Cache traffic lands in the ``jax/cache/*`` telemetry counters
+    (telemetry/jax_hooks.py bridges jax.monitoring), so ``tpu-ddp trace
+    summarize`` shows the warm-start wins in its counters snapshot."""
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # jax latches its cache-enabled decision at the FIRST compile of the
+    # process (compilation_cache._cache_checked): if anything compiled
+    # before this call — a library embedder, an earlier Trainer without a
+    # cache dir — the new config would be silently ignored. Un-latch so
+    # the next compile re-evaluates it.
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:  # internals moved: the config updates still apply
+        pass
+
+
 @dataclasses.dataclass
 class TrainConfig:
     """Union of the reference's hardcoded constants and the vestigial
@@ -66,6 +89,15 @@ class TrainConfig:
     n_devices: Optional[int] = None       # None = all; 1 = main_no_ddp mode
     parallelism: Optional[str] = None     # dp|fsdp|tp|pp|sp|ep; None = infer
                                           # from mesh (default dp)
+    zero1: bool = False                   # ZeRO-1 weight-update sharding
+                                          # (dp/sp): reduce-scatter grads,
+                                          # update only the local 1/N shard
+                                          # of params + optimizer state
+                                          # (state lives scattered — ~1/N
+                                          # the optimizer HBM), all-gather
+                                          # params back. Same math as the
+                                          # replicated update
+                                          # (parallel/zero.py)
     mesh: Optional[dict] = None           # axis sizes, e.g. {"data": 2,
                                           # "model": 4}; None = strategy default
     n_microbatches: int = 4               # pipeline microbatches (pp only)
@@ -122,6 +154,12 @@ class TrainConfig:
     profile_dir: Optional[str] = None     # emit an XLA/TPU trace (Tensor-
                                           # Board/Perfetto) for ONE steady-
                                           # state epoch (SURVEY.md §5.1)
+    compilation_cache_dir: Optional[str] = None  # persistent XLA compile
+                                          # cache (jax_compilation_cache_dir,
+                                          # applied before the first trace):
+                                          # repeat runs skip recompiles;
+                                          # hits/misses surface as
+                                          # jax/cache/* telemetry counters
     telemetry_dir: Optional[str] = None   # run dir for the structured
                                           # telemetry sinks (per-host JSONL
                                           # + Chrome trace + heartbeats);
@@ -188,6 +226,19 @@ class TrainConfig:
         if self.health_window < 4:
             raise ValueError(
                 f"health_window must be >= 4, got {self.health_window}"
+            )
+        if self.zero1 and self.optimizer == "lamb":
+            raise ValueError(
+                "--zero1 does not compose with --optimizer lamb (the "
+                "layer-wise trust ratio needs whole-parameter norms; "
+                "the 1/N update shards cannot provide them)"
+            )
+        if self.zero1 and self.parallelism not in (None, "dp", "sp"):
+            raise ValueError(
+                f"--zero1 is not supported with --parallelism "
+                f"{self.parallelism}: fsdp/fsdp_tp already scatter the "
+                "optimizer state (ZeRO-3 subsumes ZeRO-1); tp/pp/ep own "
+                "their state layout"
             )
         return self
     freeze_prefixes: Optional[tuple] = None  # e.g. ("fc",) trains head only
@@ -284,6 +335,8 @@ class Trainer:
         the dataset loader — used by the k-fold driver and tests."""
         self.config = config
         config.validate()
+        if config.compilation_cache_dir:
+            apply_compilation_cache(config.compilation_cache_dir)
         devices = jax.devices()
         if config.n_devices:
             devices = devices[: config.n_devices]
@@ -373,6 +426,25 @@ class Trainer:
             from tpu_ddp.train.optim import freeze_all_but
 
             freeze = freeze_all_but(tuple(config.freeze_prefixes))
+        # ZeRO-1: the optimizer chain runs on flattened 1/N update-space
+        # shards inside the step, so structure-dependent pieces must be
+        # precomputed on the ORIGINAL shapes: the kernels-only decay mask
+        # from an abstract init (ndim is gone after flattening), and
+        # global-norm clipping switches to the psum-over-data variant
+        # (see make_optimizer's zero1_axis).
+        decay_mask = None
+        zero1_axis = None
+        if config.zero1:
+            zero1_axis = DATA_AXIS
+            if config.weight_decay > 0:
+                from tpu_ddp.train.optim import _decay_mask
+                from tpu_ddp.train.state import init_model_variables
+
+                abstract_params, _ = jax.eval_shape(
+                    lambda: init_model_variables(
+                        self.model, jax.random.key(0))
+                )
+                decay_mask = _decay_mask(abstract_params)
         self.tx = make_optimizer(
             lr=config.lr,
             optimizer=config.optimizer,
@@ -384,6 +456,8 @@ class Trainer:
             grad_clip_norm=config.grad_clip_norm,
             freeze_predicate=freeze,
             ema_decay=config.ema_decay,
+            decay_mask=decay_mask,
+            zero1_axis=zero1_axis,
         )
         from tpu_ddp.train.losses import (
             binary_cross_entropy_with_logits,
@@ -407,6 +481,7 @@ class Trainer:
 
         self.state_shardings = None   # None == fully replicated (dp/sp)
         self._prepare_eval = None     # strategy hook (pp re-layouts params)
+        self._zero1 = None            # Zero1Partition when --zero1
         if self.parallelism == "dp":
             self._init_dp_steps(loss_fn, with_acc)
         else:
@@ -459,16 +534,29 @@ class Trainer:
             if config.resume and self.checkpointer.latest_step() is not None:
                 from tpu_ddp.parallel.mesh import replicated_sharding
 
-                restored = self.checkpointer.restore(self.state)
-                # Lay restored arrays back out in the TRAINING layout: the
-                # sharded strategies (fsdp/tp/pp/ep) resume scattered, the
-                # replicated ones (dp/sp) resume replicated — the restore
-                # template (self.state) already carries the right shardings,
-                # this device_put just pins the invariant.
-                self.state = jax.device_put(
-                    restored,
-                    self.state_shardings or replicated_sharding(self.mesh),
-                )
+                if self._zero1 is not None:
+                    # Checkpoints are ALWAYS the de-sharded (replicated-
+                    # layout) state — _ckpt_state below — so a --zero1 run
+                    # restores a replicated run's checkpoint and vice
+                    # versa. Restore through the de-sharded template, then
+                    # re-scatter the optimizer state onto the mesh.
+                    restored = self.checkpointer.restore(
+                        self._zero1.deshard_state(self.state)
+                    )
+                    self.state = self._zero1.shard_state(restored, self.mesh)
+                else:
+                    restored = self.checkpointer.restore(self.state)
+                    # Lay restored arrays back out in the TRAINING layout:
+                    # the sharded strategies (fsdp/tp/pp/ep) resume
+                    # scattered, the replicated ones (dp/sp) resume
+                    # replicated — the restore template (self.state)
+                    # already carries the right shardings, this device_put
+                    # just pins the invariant.
+                    self.state = jax.device_put(
+                        restored,
+                        self.state_shardings
+                        or replicated_sharding(self.mesh),
+                    )
                 self.resumed_step = int(self.state.step)
                 self.logger.log_text(
                     f"resumed from step {self.resumed_step}"
@@ -476,7 +564,8 @@ class Trainer:
 
     def _init_dp_steps(self, loss_fn, with_acc):
         """Flagship data-parallel path: shard_map DDP-semantics step, scan
-        fusion, on-device augmentation, replicated state."""
+        fusion, on-device augmentation, replicated state (``--zero1``:
+        replicated params, SCATTERED optimizer state)."""
         config = self.config
         if config.pretrained_dir:
             from tpu_ddp.parallel.mesh import replicated_sharding
@@ -491,9 +580,46 @@ class Trainer:
                 ),
                 replicated_sharding(self.mesh),
             )
+        elif config.zero1:
+            # Fresh zero1 init: the SAME init recipe as create_train_state
+            # (init_model_variables — seed-parity with the replicated path
+            # depends on sharing it), but tx.init runs under out_shardings
+            # that scatter the update-space leaves — the replicated
+            # optimizer state (the HBM being saved) is never materialized,
+            # not even transiently at step 0.
+            import jax.numpy as jnp
+
+            from tpu_ddp.parallel.mesh import replicated_sharding
+            from tpu_ddp.parallel.zero import Zero1Partition
+            from tpu_ddp.train.state import TrainState, init_model_variables
+
+            params, batch_stats = init_model_variables(
+                self.model, jax.random.key(config.seed))
+            params = jax.device_put(params, replicated_sharding(self.mesh))
+            self._zero1 = Zero1Partition(
+                self.tx, params, self.data_size, axis=DATA_AXIS)
+            self.state = TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                batch_stats=jax.device_put(
+                    batch_stats, replicated_sharding(self.mesh)),
+                opt_state=self._zero1.init_opt_state(params, self.mesh),
+            )
         else:
             self.state = create_train_state(
                 self.model, self.tx, jax.random.key(config.seed)
+            )
+        if config.zero1:
+            if self._zero1 is None:  # finetune path: scatter the restored
+                from tpu_ddp.parallel.zero import Zero1Partition
+
+                self._zero1 = Zero1Partition(
+                    self.tx, self.state.params, self.data_size,
+                    axis=DATA_AXIS,
+                )
+                self.state = self._zero1.shard_state(self.state, self.mesh)
+            self.state_shardings = self._zero1.state_shardings(
+                self.state, self.mesh
             )
         if config.grad_accum_steps > 1:
             from tpu_ddp.train.steps import make_grad_accum_train_step
@@ -508,7 +634,7 @@ class Trainer:
                 accum_steps=config.grad_accum_steps,
                 loss_fn=loss_fn, compute_accuracy=with_acc,
                 remat=config.remat, aux_weight=config.aux_weight,
-                health=self._health,
+                health=self._health, zero1=self._zero1,
             )
         else:
             self.train_step = make_train_step(
@@ -517,7 +643,7 @@ class Trainer:
                 augment=config.augment, augment_seed=config.seed,
                 mixup_alpha=config.mixup_alpha,
                 aux_weight=config.aux_weight,
-                health=self._health,
+                health=self._health, zero1=self._zero1,
             )
         self.multi_step = None
         # Clamp to the epoch length: a scan longer than the epoch would
@@ -543,7 +669,7 @@ class Trainer:
                 augment=config.augment, augment_seed=config.seed,
                 mixup_alpha=config.mixup_alpha,
                 aux_weight=config.aux_weight,
-                health=self._health,
+                health=self._health, zero1=self._zero1,
             )
             self.stacked_sharding = stacked_batch_sharding(self.mesh)
         self.eval_step = make_eval_step(
@@ -607,6 +733,7 @@ class Trainer:
             remat=config.remat,
             grad_accum_steps=config.grad_accum_steps,
             health=self._health,
+            zero1=config.zero1,
         )
         self.state = strategy.state
         self.train_step = strategy.train_step
@@ -615,6 +742,7 @@ class Trainer:
         self.batch_sharding = strategy.batch_shardings
         self.state_shardings = strategy.state_shardings
         self._prepare_eval = strategy.prepare_eval
+        self._zero1 = strategy.zero1
         self.multi_step = None
         self.steps_per_call = 1
 
@@ -1157,7 +1285,8 @@ class Trainer:
                     **extra,
                 )
                 if self.checkpointer and epoch % c.checkpoint_every_epochs in (0, 1):
-                    self.checkpointer.save(int(self.state.step), self.state)
+                    self.checkpointer.save(
+                        int(self.state.step), self._ckpt_state())
             if c.eval_each_epoch:
                 with tel.span("eval", epoch=epoch):
                     acc, loss = self.evaluate()
@@ -1181,7 +1310,7 @@ class Trainer:
                         # save_as_only: resume replay can produce a new
                         # best at an existing or OLDER step number
                         self.best_checkpointer.save_as_only(
-                            step_now, self.state)
+                            step_now, self._ckpt_state())
                         from tpu_ddp.parallel.runtime import (
                             is_primary_process,
                         )
@@ -1241,9 +1370,10 @@ class Trainer:
                     + ")"
                 )
         if save_final:
-            self.checkpointer.save(int(self.state.step), self.state, wait=True)
+            self.checkpointer.save(
+                int(self.state.step), self._ckpt_state(), wait=True)
         if self.best_checkpointer:
-            self.best_checkpointer.manager.wait_until_finished()
+            self.best_checkpointer.wait_until_finished()
         from tpu_ddp.parallel.runtime import is_primary_process
 
         if c.plot_curves and is_primary_process():
@@ -1345,19 +1475,40 @@ class Trainer:
         achieved = (flops / steps_per_exec) * (steady_steps / steady_seconds)
         return achieved / peak_flops_per_chip()
 
+    def _ckpt_state(self):
+        """The state a checkpoint should persist: under --zero1 the
+        scattered optimizer state is de-sharded back to the ORIGINAL optax
+        layout first, so every checkpoint on disk has one format and
+        --resume composes with --zero1 in either direction (restore
+        re-scatters; see __init__)."""
+        if self._zero1 is not None:
+            return self._zero1.deshard_state(self.state)
+        return self.state
+
     def _eval_source_state(self):
         """The state eval/predict should read weights from: the EMA shadow
         when --ema-decay is on (the averaged weights are the ones an EMA
         recipe deploys), re-laid-out by the strategy hook if one exists
         (pp restacks params stage-major) — EMA swap happens FIRST so the
-        hook sees a params tree in its expected training layout."""
+        hook sees a params tree in its expected training layout.
+
+        Under --zero1 the EMA shadow lives as flat update-space shards
+        inside the scattered opt state — de-flatten it back to the param
+        layout (one all-gather, eval cadence); the opt state itself is
+        dropped from the eval input (the eval step reads only
+        params/batch_stats, and its replicated in_specs must not force a
+        pointless gather of the shards)."""
         s = self.state
         if self.config.ema_decay:
             from tpu_ddp.train.optim import find_ema
 
             ema = find_ema(s.opt_state)
             if ema is not None:
+                if self._zero1 is not None:
+                    ema = self._zero1.deshard_params(ema)
                 s = s.replace(params=ema)
+        if self._zero1 is not None:
+            s = s.replace(opt_state={})
         return self._prepare_eval(s) if self._prepare_eval else s
 
     def evaluate(self) -> tuple:
