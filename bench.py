@@ -413,6 +413,73 @@ def _bench_zero1() -> dict:
     return row
 
 
+def _bench_grad_compress_int8() -> dict:
+    """--grad-compress int8 on the SAME model/batch as the dispatch-per-
+    step DP baseline: images/sec/chip with the block-scaled quantized
+    ring gradient sync plus the static wire-byte accounting — the bench-
+    JSON evidence for the ~4x gradient-bytes claim (parallel/
+    compression.py; compiler-side HLO evidence in benchmarks/aot_v5e.json
+    dp_zero1_int8_resnet50_bf16_b256x8)."""
+    import jax
+    import numpy as np
+
+    from tpu_ddp.data import synthetic_cifar10
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
+    from tpu_ddp.parallel.compression import GradCompression, GradCompressor
+    from tpu_ddp.train import create_train_state, make_optimizer, make_train_step
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    mesh = create_mesh(MeshSpec(data=-1), devices)
+    model = NetResDeep()
+    tx = make_optimizer(lr=1e-2, momentum=0.9)
+    state = create_train_state(model, tx, jax.random.key(0))
+    comp = GradCompressor(
+        GradCompression(mode="int8", error_feedback=True),
+        state.params, n_chips,
+    )
+    state = state.replace(grad_residual=comp.init_residual(mesh))
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    state = state.replace(
+        step=jax.device_put(state.step, rep),
+        params=jax.device_put(state.params, rep),
+        batch_stats=jax.device_put(state.batch_stats, rep),
+        opt_state=jax.device_put(state.opt_state, rep),
+    )
+    step = make_train_step(model, tx, mesh, compress=comp)
+
+    per_shard = 32
+    global_batch = per_shard * n_chips
+    imgs, labels = synthetic_cifar10(global_batch, seed=0)
+    batch = jax.device_put(
+        {
+            "image": imgs.astype(np.float32),
+            "label": labels,
+            "mask": np.ones(global_batch, bool),
+        },
+        batch_sharding(mesh),
+    )
+    _, calls, elapsed = _measure(
+        step, state, batch, target_seconds=4.0, max_calls=400
+    )
+    per_chip = calls * global_batch / elapsed / n_chips
+    return {
+        "images_per_sec_per_chip": round(per_chip, 1),
+        "model": "netresdeep",
+        "dtype": "float32",
+        "per_shard_batch": per_shard,
+        "steps_per_call": 1,
+        "momentum": 0.9,
+        "n_chips": n_chips,
+        "grad_compress": "int8",
+        "error_feedback": True,
+        "wire_accounting": comp.accounting(),
+    }
+
+
 def _cifar_compute_point(model, tx, *, per_shard: int, seed: int = 1,
                          max_calls: int = 50) -> dict:
     """ONE unfused CIFAR-shape (32x32) measurement point: the single
@@ -906,6 +973,10 @@ def child_main(quick: bool) -> None:
     # (--zero1) — throughput + per-device memory next to the replicated
     # row. Cheap on any backend (NetResDeep f32).
     _leg("zero1_weight_update_sharding", _bench_zero1)
+    _emit(out)
+    # Quantized gradient collectives (--grad-compress int8): same
+    # model/batch again, int8 ring sync + wire-byte accounting.
+    _leg("grad_compress_int8", _bench_grad_compress_int8)
     _emit(out)
     if _is_tpu_child():
         # Cheapest compiles first; the ResNet-50 bf16 compile is the most

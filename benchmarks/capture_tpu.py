@@ -90,6 +90,11 @@ _LEG_CODE = {
     # for the sharded vs replicated optimizer state (the 1/N HBM claim).
     "zero1": "import bench; print(__import__('json').dumps("
              "bench._bench_zero1()))",
+    # Quantized gradient collectives (--grad-compress int8): same
+    # model/batch as the dispatch baseline; the row carries throughput +
+    # the static wire-byte accounting (~4x fewer gradient bytes/hop).
+    "grad_compress_int8": "import bench; print(__import__('json').dumps("
+                          "bench._bench_grad_compress_int8()))",
     "sweep_k32_b256": "import bench; print(__import__('json').dumps("
                       "bench._bench_flagship_point(32, 256)))",
     "sweep_k128_b32": "import bench; print(__import__('json').dumps("

@@ -80,6 +80,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "params. Identical training math; checkpoints "
                         "stay in the replicated layout so --resume "
                         "composes in either direction")
+    p.add_argument("--grad-compress", choices=["none", "bf16", "int8"],
+                   default="none",
+                   help="quantize the gradient sync's wire payloads "
+                        "(dp/sp): the pmean/reduce-scatter becomes a "
+                        "ppermute ring whose hops carry block-scaled "
+                        "int8 (~4x fewer bytes) or bf16 (2x) while "
+                        "accumulation stays f32 on-device. Composes "
+                        "with --zero1 (the compressed ring replaces its "
+                        "grad reduce-scatter)")
+    p.add_argument("--grad-compress-block", type=int, default=256,
+                   metavar="N",
+                   help="int8 mode: elements sharing one f32 max-abs "
+                        "scale (smaller = tighter error, more scale "
+                        "bytes on the wire)")
+    p.add_argument("--grad-compress-error-feedback", action="store_true",
+                   help="carry each replica's quantization error and add "
+                        "it back into the next step's gradient (the "
+                        "residual rides the TrainState, is checkpointed, "
+                        "and keeps long-run convergence unbiased)")
     p.add_argument("--mesh", default=None, metavar="AXES",
                    help="device mesh axis sizes, e.g. data=2,model=4 "
                         "(axes: data, pipeline, expert, sequence, model; "
@@ -339,6 +358,9 @@ def config_from_args(args) -> TrainConfig:
         n_devices=n_devices,
         parallelism=args.parallelism,
         zero1=args.zero1,
+        grad_compress=args.grad_compress,
+        grad_compress_block=args.grad_compress_block,
+        grad_compress_error_feedback=args.grad_compress_error_feedback,
         mesh=mesh_sizes,
         n_microbatches=args.microbatches,
         pp_schedule=args.pp_schedule,

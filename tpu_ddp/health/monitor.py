@@ -209,7 +209,7 @@ class HealthMonitor:
 
         tel = self.telemetry
         for key in ("loss", "grad_norm", "param_norm", "update_norm",
-                    "update_ratio"):
+                    "update_ratio", "compress_error_norm"):
             if key in host and math.isfinite(host[key]):
                 tel.gauge(f"health/{key}").set(host[key])
 

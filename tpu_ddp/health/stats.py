@@ -22,6 +22,11 @@ Schema (``metrics["health"]``), shared by every parallelism family:
 - ``all_finite``     — conjunction of the three (the skip-step gate)
 - ``per_layer``      — optional {"grad_norm"|"param_norm": {path: norm}}
   breakdown (compiled in when the per-layer stride is enabled)
+- ``compress_error_norm`` — optional (present only under
+  ``--grad-compress``): global L2 norm of the quantization error the
+  compressed gradient ring introduced THIS step (the wire drift the
+  error-feedback residual will repay next step) — how the flight
+  recorder sees quantization drift (parallel/compression.py)
 
 Finite-ness is established by COUNTING non-finite elements, not by
 inspecting the norms: a norm can overflow to inf from large-but-finite
@@ -120,6 +125,7 @@ def assemble_stats(
     update_sq,
     update_bad,
     per_layer: Optional[dict] = None,
+    compress_error_sq=None,
 ) -> Dict[str, Any]:
     """Build the schema dict from pre-reduced scalars. Step builders whose
     gradients are physically sharded (pipeline stages) reduce the pieces
@@ -142,13 +148,16 @@ def assemble_stats(
         "updates_finite": updates_finite,
         "all_finite": loss_finite & grads_finite & updates_finite,
     }
+    if compress_error_sq is not None:
+        stats["compress_error_norm"] = jnp.sqrt(_f32(compress_error_sq))
     if per_layer is not None:
         stats["per_layer"] = per_layer
     return stats
 
 
 def health_stats(
-    *, loss, grads, params, updates, per_layer: bool = False
+    *, loss, grads, params, updates, per_layer: bool = False,
+    compress_error_sq=None,
 ) -> Dict[str, Any]:
     """The standard (replicated / GSPMD-global trees) stats computation.
 
@@ -173,6 +182,7 @@ def health_stats(
         update_sq=tree_sq(updates),
         update_bad=tree_nonfinite(updates),
         per_layer=pl,
+        compress_error_sq=compress_error_sq,
     )
 
 

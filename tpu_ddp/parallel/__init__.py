@@ -50,6 +50,11 @@ _LAZY = {
     "make_ep_train_step": "expert_parallel",
     "Zero1Partition": "zero",
     "clip_by_global_norm_sharded": "zero",
+    "GradCompression": "compression",
+    "GradCompressor": "compression",
+    "ring_all_reduce": "collectives",
+    "ring_reduce_scatter": "collectives",
+    "wire_bytes_table": "compression",
 }
 
 
@@ -96,5 +101,10 @@ __all__ = [
     "MOE_EP_RULES",
     "Zero1Partition",
     "clip_by_global_norm_sharded",
+    "GradCompression",
+    "GradCompressor",
+    "ring_all_reduce",
+    "ring_reduce_scatter",
+    "wire_bytes_table",
     "make_ep_train_step",
 ]

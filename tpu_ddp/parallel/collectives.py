@@ -16,6 +16,7 @@ from __future__ import annotations
 import jax
 
 import tpu_ddp.compat  # noqa: F401  (jax.shard_map/typeof shims)
+import jax.numpy as jnp
 from jax import lax
 
 
@@ -53,6 +54,105 @@ def axis_index(axis: str):
 
 def axis_size(axis: str):
     return lax.axis_size(axis)
+
+
+def ring_reduce_scatter(x, axis: str, *, mode: str = "f32",
+                        block: int = 256, with_error: bool = False):
+    """Ring reduce-scatter of a 1-D array built from ``ppermute``, with
+    each hop's payload optionally quantized on the wire
+    (``parallel/compression.py``) while accumulation stays f32 on-device.
+
+    ``x``: per-device (length divisible by the axis size N). Device i
+    returns the i-th of N equal chunks of the cross-device SUM — the
+    ``lax.psum_scatter(scatter_dimension=0, tiled=True)`` layout. The
+    schedule is the classic N-1-hop ring: device i starts holding its
+    local partial for chunk i-1, and at every hop sends its partial one
+    position around the ring (quantize -> wire -> dequantize) and adds
+    its own local contribution for the chunk it just received, so chunk c
+    accumulates visiting c+1, c+2, ..., c in f32.
+
+    ``mode="f32"`` is the correctness anchor for the schedule: identity
+    payloads make the ring compute exactly a reduce-scatter, equal to
+    ``lax.psum_scatter`` up to float32 summation ORDER (the ring folds
+    chunk c starting at device c+1; XLA:CPU folds every chunk in rank
+    order — IEEE addition is commutative but not associative, so random
+    floats match to ULPs and exact-arithmetic inputs match bit-for-bit;
+    both pinned by tests/test_compression.py).
+
+    Returns ``(chunk, err)``: ``err`` (when ``with_error``) is the
+    quantization error THIS device introduced, a full-length f32 array
+    with each hop's error at its chunk's offsets — the error-feedback
+    residual contribution. ``err`` is None when not requested, all-zero
+    in f32 mode."""
+    from tpu_ddp.parallel.compression import (
+        dequantize_chunk,
+        quantize_chunk,
+    )
+
+    n = lax.axis_size(axis)
+    if x.shape[0] % n:
+        raise ValueError(
+            f"ring_reduce_scatter: length {x.shape[0]} not divisible by "
+            f"axis size {n}"
+        )
+    s = x.shape[0] // n
+    if n == 1:
+        return x, (jnp.zeros_like(x) if with_error else None)
+    chunks = x.reshape(n, s)
+    idx = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    p = jnp.take(chunks, (idx - 1) % n, axis=0, mode="wrap")
+    err = jnp.zeros_like(x) if with_error else None
+    for step in range(n - 1):
+        payload = quantize_chunk(p, mode, block)
+        if with_error and mode != "f32":
+            e = p - dequantize_chunk(payload, mode, block, s)
+            # the chunk being sent this hop is (idx - 1 - step) mod n
+            err = lax.dynamic_update_slice(
+                err, e, (((idx - 1 - step) % n) * s,))
+        payload = jax.tree.map(
+            lambda t: lax.ppermute(t, axis, perm), payload)
+        p = dequantize_chunk(payload, mode, block, s)
+        p = p + jnp.take(chunks, (idx - 2 - step) % n, axis=0, mode="wrap")
+    return p, err
+
+
+def ring_all_reduce(x, axis: str, *, mode: str = "f32", block: int = 256,
+                    with_error: bool = False):
+    """Ring all-reduce (SUM) with wire compression in BOTH phases:
+    the compressed ring reduce-scatter above, then each device quantizes
+    its reduced chunk ONCE and the payloads are all-gathered — every
+    device (owner included) dequantizes the same bytes, so the result is
+    bit-identical across the ring even in the lossy modes (the property
+    DDP param consistency rests on). In ``mode="f32"`` this equals
+    ``lax.psum`` up to the reduce-scatter's summation-order caveat.
+
+    Returns ``(sum, err)`` with ``err`` as in ``ring_reduce_scatter``
+    plus the owner-side all-gather-phase quantization error."""
+    from tpu_ddp.parallel.compression import (
+        dequantize_chunk,
+        quantize_chunk,
+    )
+
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x, (jnp.zeros_like(x) if with_error else None)
+    s = x.shape[0] // n
+    chunk, err = ring_reduce_scatter(
+        x, axis, mode=mode, block=block, with_error=with_error)
+    payload = quantize_chunk(chunk, mode, block)
+    if with_error and mode != "f32":
+        e = chunk - dequantize_chunk(payload, mode, block, s)
+        idx = lax.axis_index(axis)
+        err = lax.dynamic_update_slice(err, e, (idx * s,))
+    gathered = jax.tree.map(
+        lambda t: lax.all_gather(t, axis, axis=0, tiled=False), payload)
+    rows = jnp.stack([
+        dequantize_chunk(
+            jax.tree.map(lambda t: t[i], gathered), mode, block, s)
+        for i in range(n)
+    ])
+    return rows.reshape(-1), err
 
 
 def sync_gradients(grads, axis: str):

@@ -44,6 +44,7 @@ def make_sp_train_step(
     donate: bool = True,
     health: Optional[HealthConfig] = None,
     zero1=None,
+    compress=None,
 ):
     """Compiled train step for an SP-aware model (ViT with sp_axis=seq_axis).
 
@@ -56,7 +57,16 @@ def make_sp_train_step(
     scatters over ``data`` (replicated over ``sequence`` — the update space
     partitions over the DP axis only); the sequence-axis collective for the
     distributed attention partials is unchanged.
+
+    ``compress`` (``tpu_ddp.parallel.compression.GradCompressor``): the
+    DATA-axis gradient collective runs as the block-scaled quantized ring
+    (--grad-compress). Seq-axis sync is untouched; the ring input is
+    seq-identical after it, so the quantized output (and the
+    error-feedback residual) stays replicated over ``sequence``.
     """
+    from tpu_ddp.train.steps import _bind_compressor, state_specs_for
+
+    _bind_compressor(zero1, compress)
 
     def compute_loss(params, batch):
         logits = model.apply({"params": params}, batch["image"], train=True)
@@ -67,61 +77,80 @@ def make_sp_train_step(
         # varying-axes tracking inserts the correct sequence-axis psums for
         # the distributed attention partials during the transpose. SHIMMED
         # jax: both collectives move to the explicit grad sync below.
-        # zero1: the data sync is the reduce-scatter — the loss stays local.
-        if GRAD_SYNC_IN_AD and zero1 is None:
+        # zero1/compress: the data sync is the (ring) reduce-scatter —
+        # the loss stays local.
+        if GRAD_SYNC_IN_AD and zero1 is None and compress is None:
             return lax.pmean(loss, data_axis)
         return loss
 
     def shard_step(state: TrainState, batch):
-        p_in = (zero1.varying(state.params) if zero1 is not None
-                else state.params)
+        if zero1 is not None:
+            p_in = zero1.varying(state.params)
+        elif compress is not None:
+            p_in = compress.varying(state.params)
+        else:
+            p_in = state.params
         loss, grads = jax.value_and_grad(compute_loss)(p_in, batch)
+        data_local = zero1 is not None or compress is not None
         if not GRAD_SYNC_IN_AD:
             # On old jax, psum transposes to psum: the n_seq identical
             # replicated-loss seeds re-sum through the model's pooling
             # psum, so every partial arrives n_seq-fold — pmean (not
             # psum) over the ring both sums the per-shard partials and
-            # cancels that factor; then DDP-average over data (zero1:
-            # over data the average moves into the reduce-scatter).
+            # cancels that factor; then DDP-average over data (zero1/
+            # compress: over data the average moves into the ring).
             seq_done = jax.tree.map(
                 lambda g: lax.pmean(g, seq_axis), grads)
-            grads = (seq_done if zero1 is not None else jax.tree.map(
+            grads = (seq_done if data_local else jax.tree.map(
                 lambda g: lax.pmean(g, data_axis), seq_done))
             loss = lax.pmean(loss, data_axis)
-        elif zero1 is not None:
+        elif data_local:
             loss = lax.pmean(loss, data_axis)
+        ef = compress is not None and compress.config.error_feedback
+        want_err = compress is not None and (ef or health is not None)
+        residual = state.grad_residual if ef else None
+        err_state = None
         if zero1 is not None:
-            new_params, new_opt_state, gshards, ushards = (
-                zero1.sharded_update(grads, state.params, state.opt_state)
+            new_params, new_opt_state, gshards, ushards, err_state = (
+                zero1.sharded_update(grads, state.params, state.opt_state,
+                                     residual=residual, with_error=want_err)
             )
         else:
+            if compress is not None:
+                grads, err_state = compress.all_reduce_mean(
+                    grads, residual, with_error=want_err)
             updates, new_opt_state = tx.update(
                 grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
+        new_residual = err_state if ef else state.grad_residual
         metrics = {"loss": loss}
         if health is not None:
             # grads are synced over BOTH mesh axes by this point (either
             # sync mode; zero1's shards are seq-complete and data-
             # scattered, psum'd back to globals inside health_stats), so
             # the stats are true globals — same schema as the DP step
+            err_sq = compress.error_sq(err_state) if want_err else None
             if zero1 is not None:
                 hstats = zero1.health_stats(
                     loss=loss, grad_shards=gshards, params=state.params,
                     update_shards=ushards, per_layer=health.per_layer,
+                    compress_error_sq=err_sq,
                 )
             else:
                 hstats = health_stats(
                     loss=loss, grads=grads, params=state.params,
                     updates=updates, per_layer=health.per_layer,
+                    compress_error_sq=err_sq,
                 )
-            new_params, new_opt_state = guard_step(
-                health, hstats, (new_params, new_opt_state),
-                (state.params, state.opt_state),
+            (new_params, new_opt_state, new_residual) = guard_step(
+                health, hstats, (new_params, new_opt_state, new_residual),
+                (state.params, state.opt_state, state.grad_residual),
             )
             metrics["health"] = hstats
         return (
             state.replace(
-                step=state.step + 1, params=new_params, opt_state=new_opt_state
+                step=state.step + 1, params=new_params,
+                opt_state=new_opt_state, grad_residual=new_residual,
             ),
             metrics,
         )
@@ -131,7 +160,7 @@ def make_sp_train_step(
         "label": P(data_axis),
         "mask": P(data_axis),
     }
-    state_specs = zero1.state_specs() if zero1 is not None else P()
+    state_specs = state_specs_for(zero1, compress, data_axis)
     sharded = jax.shard_map(
         shard_step,
         mesh=mesh,

@@ -120,12 +120,15 @@ class Zero1Partition:
     """
 
     def __init__(self, tx, params_template, n_shards: int,
-                 axis: str = DATA_AXIS):
+                 axis: str = DATA_AXIS, compress=None):
         if n_shards < 1:
             raise ValueError(f"n_shards must be >= 1, got {n_shards}")
         self.tx = tx
         self.axis = axis
         self.n_shards = n_shards
+        self.compress = None
+        if compress is not None:
+            self.set_compression(compress)
         template = jax.eval_shape(lambda p: p, params_template)
         self.param_slots = jax.tree.map(
             lambda leaf: _leaf_slot(leaf, n_shards), template
@@ -161,6 +164,21 @@ class Zero1Partition:
             self.opt_slots, is_leaf=_is_slot,
         )
 
+    def set_compression(self, compress) -> None:
+        """Attach a ``GradCompressor`` (parallel/compression.py): the grad
+        reduce-scatter below swaps ``lax.psum_scatter`` for the
+        block-scaled quantized ring — wire bytes drop ~4x (int8) / 2x
+        (bf16) while the shard update stays f32. The compressor must be
+        built from the same params template and shard count (its per-leaf
+        padding is the same arithmetic as this partition's)."""
+        if compress.n_shards != self.n_shards or compress.axis != self.axis:
+            raise ValueError(
+                f"GradCompressor layout (n_shards={compress.n_shards}, "
+                f"axis={compress.axis!r}) does not match this partition "
+                f"(n_shards={self.n_shards}, axis={self.axis!r})"
+            )
+        self.compress = compress
+
     # ---- flat update space (host + in-graph) ----------------------------
 
     def flatten(self, tree):
@@ -175,10 +193,19 @@ class Zero1Partition:
 
     # ---- in-graph (inside shard_map) ------------------------------------
 
-    def reduce_scatter_mean(self, grads):
-        """Local (unsynced) grad tree -> this shard's 1/N slice of the
-        globally AVERAGED gradient — the pmean replacement. Same adds in
-        the same order as the all-reduce, restricted to the local slice."""
+    def reduce_scatter_mean(self, grads, residual=None,
+                            with_error: bool = False):
+        """Local (unsynced) grad tree -> ``(shards, err_state)``: this
+        shard's 1/N slice of the globally AVERAGED gradient — the pmean
+        replacement. Same adds in the same order as the all-reduce,
+        restricted to the local slice. With a compressor attached
+        (``set_compression``) the psum_scatter becomes the block-scaled
+        quantized ring instead (same layout, ~4x fewer wire bytes);
+        ``residual``/``with_error`` thread the error-feedback state
+        through it. ``err_state`` is None on the uncompressed path."""
+        if self.compress is not None:
+            return self.compress.reduce_scatter_mean_flat(
+                self.flatten(grads), residual, with_error=with_error)
         n = self.n_shards
 
         def rs(g):
@@ -186,7 +213,7 @@ class Zero1Partition:
                 g, self.axis, scatter_dimension=0, tiled=True
             ) / n
 
-        return jax.tree.map(rs, self.flatten(grads))
+        return jax.tree.map(rs, self.flatten(grads)), None
 
     def local_shard(self, flat_tree):
         """This shard's slice of a replicated flat tree (params enter the
@@ -236,16 +263,20 @@ class Zero1Partition:
             lambda p: lax.pcast(p, (self.axis,), to="varying"), params
         )
 
-    def sharded_update(self, grads, params, opt_state):
+    def sharded_update(self, grads, params, opt_state, residual=None,
+                       with_error: bool = False):
         """The ZeRO-1 update tail, run INSIDE the compiled step: returns
-        ``(new_params, new_opt_state, grad_shards, update_shards)``.
-        ``grads`` are the LOCAL (per-replica, unsynced — but already
-        microbatch-averaged if accumulating) gradients; ``params`` the
-        replicated originals; ``opt_state`` the local opt shard. The
-        optimizer is ``self.tx`` — the one this partition derived its
-        opt-state layout from (a different tx here could not match
-        ``opt_slots``, so it is not a parameter)."""
-        gsh = self.reduce_scatter_mean(grads)
+        ``(new_params, new_opt_state, grad_shards, update_shards,
+        err_state)``. ``grads`` are the LOCAL (per-replica, unsynced —
+        but already microbatch-averaged if accumulating) gradients;
+        ``params`` the replicated originals; ``opt_state`` the local opt
+        shard; ``residual``/``with_error`` the --grad-compress
+        error-feedback threading (``err_state`` is the new residual, None
+        without compression). The optimizer is ``self.tx`` — the one this
+        partition derived its opt-state layout from (a different tx here
+        could not match ``opt_slots``, so it is not a parameter)."""
+        gsh, err_state = self.reduce_scatter_mean(
+            grads, residual, with_error=with_error)
         psh = self.local_shard(self.flatten(params))
         with jax.named_scope("tpu_ddp.zero1_shard_update"):
             updates, new_opt_state = self.tx.update(gsh, opt_state, psh)
@@ -253,10 +284,10 @@ class Zero1Partition:
             new_psh = optax.apply_updates(psh, updates)
         with jax.named_scope("tpu_ddp.zero1_allgather_params"):
             new_params = self.gather_params(new_psh)
-        return new_params, new_opt_state, gsh, updates
+        return new_params, new_opt_state, gsh, updates, err_state
 
     def health_stats(self, *, loss, grad_shards, params, update_shards,
-                     per_layer: bool = False):
+                     per_layer: bool = False, compress_error_sq=None):
         """The flight-recorder schema (health/stats.py) from SHARDED
         grads/updates: shard-local sums psum'd over the data axis — every
         shard reports the identical global number, exactly as the
@@ -281,6 +312,7 @@ class Zero1Partition:
             update_sq=psum(tree_sq(update_shards)),
             update_bad=psum(tree_nonfinite(update_shards)),
             per_layer=pl,
+            compress_error_sq=compress_error_sq,
         )
 
     # ---- specs / shardings (shard_map + device layout) ------------------

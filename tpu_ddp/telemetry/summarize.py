@@ -91,6 +91,34 @@ def last_counters(records: Iterable[dict]) -> Dict[int, dict]:
     return snaps
 
 
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024:
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} TB"
+
+
+def format_comms(counters: dict) -> List[str]:
+    """The --grad-compress comms section: bytes-on-wire vs the
+    uncompressed (f32-ring) equivalent and the effective ratio, from the
+    ``comm/*`` counters the Trainer accumulates per step
+    (parallel/compression.py accounting). Empty when the run never
+    compressed a gradient collective."""
+    wire = counters.get("comm/grad_bytes_on_wire")
+    base = counters.get("comm/grad_bytes_uncompressed")
+    if not wire:
+        return []
+    lines = [
+        "comms (gradient collectives):",
+        f"  bytes on wire        = {_human_bytes(wire)}",
+    ]
+    if base:
+        lines.append(f"  uncompressed (f32)   = {_human_bytes(base)}")
+        lines.append(f"  compression ratio    = {base / wire:.2f}x")
+    return lines
+
+
 def summarize(path: str) -> str:
     """Human-readable summary of a run dir / trace file."""
     files = find_trace_files(path)
@@ -120,4 +148,8 @@ def summarize(path: str) -> str:
             v = flat[k]
             shown = f"{v:.6g}" if isinstance(v, float) else str(v)
             lines.append(f"  {k} = {shown}")
+        comms = format_comms(flat)
+        if comms:
+            lines.append("")
+            lines.extend(comms)
     return "\n".join(lines)

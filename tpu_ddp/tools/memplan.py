@@ -51,7 +51,9 @@ def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
          image_size: int | None = None,
          num_classes: int | None = None,
          parallelism: str = "dp", axis_size: int | None = None,
-         grad_accum_steps: int = 1, zero1: bool = False) -> dict:
+         grad_accum_steps: int = 1, zero1: bool = False,
+         grad_compress: bool = False,
+         grad_compress_block: int = 256) -> dict:
     """Compile the DP train step for ``topology`` and return the memory
     report dict. Raises on compile failure (a real regression).
 
@@ -87,7 +89,8 @@ def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
             momentum=momentum, ema_decay=ema_decay, image_size=image_size,
             num_classes=num_classes, parallelism=parallelism,
             axis_size=axis_size, grad_accum_steps=grad_accum_steps,
-            zero1=zero1,
+            zero1=zero1, grad_compress=grad_compress,
+            grad_compress_block=grad_compress_block,
         )
     finally:
         jax.config.update("jax_platforms", prev_platforms)
@@ -96,7 +99,7 @@ def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
 def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
                 topology, n_devices, momentum, ema_decay, image_size,
                 num_classes, parallelism, axis_size, grad_accum_steps=1,
-                zero1=False):
+                zero1=False, grad_compress=False, grad_compress_block=256):
     import jax
 
     import jax.numpy as jnp
@@ -222,11 +225,25 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
     # Steady state: donated inputs alias outputs, so peak is roughly
     # args + temp (the compiler's temp already includes the working set).
     peak = arg + temp
+    grad_compress_report = None
+    if grad_compress:
+        # Static per-step wire-bytes table across every mode x layout
+        # (--grad-compress): what the gradient collective moves per step
+        # per device in f32 / bf16 / block-scaled int8, with and without
+        # ZeRO-1 — pure accounting from the same ring the step builders
+        # compile (parallel/compression.py), used to generate the
+        # docs/PERF.md table. No extra compile needed.
+        from tpu_ddp.parallel.compression import wire_bytes_table
+
+        grad_compress_report = wire_bytes_table(
+            state.params, mesh.shape["data"], block=grad_compress_block)
+
     report_parallelism = "dp+zero1" if zero1 else parallelism
     return {
         "model": model_name,
         "parallelism": report_parallelism,
         "zero1": zero1_report,
+        "grad_compress": grad_compress_report,
         "mesh": dict(zip(mesh.axis_names, mesh.devices.shape)),
         "image_size": image_size,
         "num_classes": num_classes,
@@ -380,6 +397,14 @@ def main(argv=None) -> dict:
                         "state bytes (static accounting), and the "
                         "compiler's argument_bytes confirms the 1/N "
                         "shrink — run with and without to diff")
+    p.add_argument("--grad-compress", action="store_true",
+                   help="add a static per-step gradient wire-bytes table "
+                        "(f32 vs bf16 vs block-scaled int8, plain-DP "
+                        "all-reduce vs ZeRO-1 reduce-scatter) to the "
+                        "report — the accounting behind docs/PERF.md's "
+                        "gradient-compression table")
+    p.add_argument("--grad-compress-block", type=int, default=256,
+                   help="int8 scale-block size for the wire table")
     p.add_argument("--axis-size", type=int, default=None,
                    help="size of the non-data mesh axis for "
                         "tp/fsdp_tp/pp/ep/sp (default: 2 for pp — vit_s4 "
@@ -406,7 +431,8 @@ def main(argv=None) -> dict:
         image_size=args.image_size,
         num_classes=args.num_classes, parallelism=args.parallelism,
         axis_size=args.axis_size, grad_accum_steps=args.grad_accum_steps,
-        zero1=args.zero1,
+        zero1=args.zero1, grad_compress=args.grad_compress,
+        grad_compress_block=args.grad_compress_block,
     )
     print(json.dumps(report, indent=1))
     if report["fits"] is False:

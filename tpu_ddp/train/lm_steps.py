@@ -31,16 +31,17 @@ from tpu_ddp.compat import GRAD_SYNC_IN_AD
 from tpu_ddp.health.stats import HealthConfig, guard_step, health_stats
 from tpu_ddp.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
 from tpu_ddp.train.state import TrainState
+from tpu_ddp.train.steps import _bind_compressor, state_specs_for
 
 
 def _with_health(health, *, loss, grads, params, updates, new_params,
-                 new_opt_state, old_opt_state):
+                 new_opt_state, old_opt_state, compress_error_sq=None):
     """Shared flight-recorder tail for the LM steps: stats on the synced
     grads/updates + the optional skip-step guard. Returns
     ``(hstats, new_params, new_opt_state)``; no-op when health is None."""
     hstats = health_stats(
         loss=loss, grads=grads, params=params, updates=updates,
-        per_layer=health.per_layer,
+        per_layer=health.per_layer, compress_error_sq=compress_error_sq,
     )
     new_params, new_opt_state = guard_step(
         health, hstats, (new_params, new_opt_state),
@@ -64,12 +65,16 @@ def make_lm_train_step(
     donate: bool = True,
     health: Optional[HealthConfig] = None,
     zero1=None,
+    compress=None,
 ) -> Callable:
     """step(state, {"tokens": (B, T) int32}) -> (state, {"loss"}).
 
     ``zero1`` (Zero1Partition): ZeRO-1 weight-update sharding — the grad
     pmean becomes a reduce-scatter and the optimizer state lives scattered
-    over ``data_axis`` (parallel/zero.py)."""
+    over ``data_axis`` (parallel/zero.py). ``compress`` (GradCompressor):
+    the sync's wire payloads are block-scaled quantized
+    (parallel/compression.py)."""
+    _bind_compressor(zero1, compress)
 
     def shard_step(state: TrainState, batch):
         tokens = batch["tokens"]
@@ -80,36 +85,54 @@ def make_lm_train_step(
             # pmean BEFORE differentiation: AD of the averaged loss emits
             # the cross-shard grad psum (the DDP semantics, exactly as in
             # train/steps.py). SHIMMED jax: sync moves to the explicit
-            # grad pmean below. zero1: the sync is the reduce-scatter —
-            # the loss stays local in both modes.
-            if GRAD_SYNC_IN_AD and zero1 is None:
+            # grad pmean below. zero1/compress: the sync is the (ring)
+            # reduce-scatter — the loss stays local in both modes.
+            if GRAD_SYNC_IN_AD and zero1 is None and compress is None:
                 return lax.pmean(loss, data_axis)
             return loss
 
-        p_in = zero1.varying(state.params) if zero1 is not None else state.params
-        loss, grads = jax.value_and_grad(compute_loss)(p_in)
-        if not GRAD_SYNC_IN_AD or zero1 is not None:
-            loss = lax.pmean(loss, data_axis)
         if zero1 is not None:
-            new_params, new_opt, gshards, ushards = zero1.sharded_update(
-                grads, state.params, state.opt_state
+            p_in = zero1.varying(state.params)
+        elif compress is not None:
+            p_in = compress.varying(state.params)
+        else:
+            p_in = state.params
+        loss, grads = jax.value_and_grad(compute_loss)(p_in)
+        if not GRAD_SYNC_IN_AD or zero1 is not None or compress is not None:
+            loss = lax.pmean(loss, data_axis)
+        ef = compress is not None and compress.config.error_feedback
+        want_err = compress is not None and (ef or health is not None)
+        residual = state.grad_residual if ef else None
+        err_state = None
+        if zero1 is not None:
+            new_params, new_opt, gshards, ushards, err_state = (
+                zero1.sharded_update(
+                    grads, state.params, state.opt_state,
+                    residual=residual, with_error=want_err,
+                )
             )
         else:
-            if not GRAD_SYNC_IN_AD:
+            if compress is not None:
+                grads, err_state = compress.all_reduce_mean(
+                    grads, residual, with_error=want_err)
+            elif not GRAD_SYNC_IN_AD:
                 grads = jax.tree.map(
                     lambda g: lax.pmean(g, data_axis), grads)
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
+        new_residual = err_state if ef else state.grad_residual
         metrics = {"loss": loss}
         if health is not None:
+            err_sq = compress.error_sq(err_state) if want_err else None
             if zero1 is not None:
                 hstats = zero1.health_stats(
                     loss=loss, grad_shards=gshards, params=state.params,
                     update_shards=ushards, per_layer=health.per_layer,
+                    compress_error_sq=err_sq,
                 )
-                new_params, new_opt = guard_step(
-                    health, hstats, (new_params, new_opt),
-                    (state.params, state.opt_state),
+                (new_params, new_opt, new_residual) = guard_step(
+                    health, hstats, (new_params, new_opt, new_residual),
+                    (state.params, state.opt_state, state.grad_residual),
                 )
                 metrics["health"] = hstats
             else:
@@ -117,14 +140,19 @@ def make_lm_train_step(
                     health, loss=loss, grads=grads, params=state.params,
                     updates=updates, new_params=new_params,
                     new_opt_state=new_opt, old_opt_state=state.opt_state,
+                    compress_error_sq=err_sq,
                 )
+                if ef:
+                    (new_residual,) = guard_step(
+                        health, metrics["health"], (new_residual,),
+                        (state.grad_residual,))
         return (
             state.replace(step=state.step + 1, params=new_params,
-                          opt_state=new_opt),
+                          opt_state=new_opt, grad_residual=new_residual),
             metrics,
         )
 
-    state_specs = zero1.state_specs() if zero1 is not None else P()
+    state_specs = state_specs_for(zero1, compress, data_axis)
     sharded = jax.shard_map(
         shard_step, mesh=mesh,
         in_specs=(state_specs, {"tokens": P(data_axis)}),
@@ -143,6 +171,7 @@ def make_sp_lm_train_step(
     donate: bool = True,
     health: Optional[HealthConfig] = None,
     zero1=None,
+    compress=None,
 ) -> Callable:
     """Sequence-parallel next-token step. ``model`` must be built with
     ``sp_axis=seq_axis``; tokens arrive (B_local, T_local) per shard.
@@ -151,7 +180,12 @@ def make_sp_lm_train_step(
     reduce-scatter and the optimizer state scatters over ``data`` (it
     stays REPLICATED over ``sequence`` — the update space is partitioned
     over the DP axis only, parallel/zero.py). The sequence-axis psum of
-    the attention partials is unchanged."""
+    the attention partials is unchanged. ``compress`` quantizes the
+    DATA-axis collective's wire payloads only (the seq-axis partials are
+    seq-identical after their psum, so the quantized ring — a
+    deterministic function of them — stays replicated over sequence,
+    residual included)."""
+    _bind_compressor(zero1, compress)
     n_seq = mesh.shape[seq_axis]
     shift_perm = [(i, (i - 1) % n_seq) for i in range(n_seq)]
 
@@ -177,50 +211,72 @@ def make_sp_lm_train_step(
             # (B, T-1); then DDP-average over data
             loss = loss_sum / count  # already seq-invariant (psum above)
             if GRAD_SYNC_IN_AD:
-                # zero1: keep the loss data-LOCAL (the reduce-scatter is
-                # the data-axis sync); seq invariance already holds
-                return loss if zero1 is not None else lax.pmean(
-                    loss, data_axis)
+                # zero1/compress: keep the loss data-LOCAL (the ring
+                # reduce-scatter is the data-axis sync); seq invariance
+                # already holds
+                if zero1 is not None or compress is not None:
+                    return loss
+                return lax.pmean(loss, data_axis)
             # SHIMMED: old jax transposes the loss_sum psum back to a psum,
             # so the n_seq identical per-shard loss seeds re-sum into an
             # n_seq over-count of every cotangent; pre-scaling the
             # differentiated value cancels it (the metric is rescaled below)
             return loss / n_seq
 
-        p_in = zero1.varying(state.params) if zero1 is not None else state.params
+        if zero1 is not None:
+            p_in = zero1.varying(state.params)
+        elif compress is not None:
+            p_in = compress.varying(state.params)
+        else:
+            p_in = state.params
         loss, grads = jax.value_and_grad(compute_loss)(p_in)
+        data_local = zero1 is not None or compress is not None
         if not GRAD_SYNC_IN_AD:
             # each (data, seq) shard's AD yields its local partial of the
             # replicated params' gradient: sum the partials over the
-            # sequence ring, then DDP-average over data (zero1: the data
-            # half of the sync moves into the reduce-scatter below)
-            seq_sync = (lax.psum if zero1 is not None else
+            # sequence ring, then DDP-average over data (zero1/compress:
+            # the data half of the sync moves into the ring below)
+            seq_sync = (lax.psum if data_local else
                         lambda g, ax: lax.pmean(lax.psum(g, ax), data_axis))
             grads = jax.tree.map(lambda g: seq_sync(g, seq_axis), grads)
             loss = lax.pmean(loss * n_seq, data_axis)
-        elif zero1 is not None:
+        elif data_local:
             loss = lax.pmean(loss, data_axis)
+        ef = compress is not None and compress.config.error_feedback
+        want_err = compress is not None and (ef or health is not None)
+        residual = state.grad_residual if ef else None
+        err_state = None
         if zero1 is not None:
-            new_params, new_opt, gshards, ushards = zero1.sharded_update(
-                grads, state.params, state.opt_state
+            new_params, new_opt, gshards, ushards, err_state = (
+                zero1.sharded_update(
+                    grads, state.params, state.opt_state,
+                    residual=residual, with_error=want_err,
+                )
             )
         else:
+            if compress is not None:
+                grads, err_state = compress.all_reduce_mean(
+                    grads, residual, with_error=want_err)
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
+        new_residual = err_state if ef else state.grad_residual
         metrics = {"loss": loss}
         if health is not None:
             # grads are fully synced over BOTH axes at this point (AD of
             # the psum'd/pmean'd loss, the explicit pmean-of-psum above,
-            # or the zero1 shards — seq-complete, data-scattered), so the
-            # stats are (data x seq)-replicated globals
+            # the dequantized ring output, or the zero1 shards —
+            # seq-complete, data-scattered), so the stats are
+            # (data x seq)-replicated globals
+            err_sq = compress.error_sq(err_state) if want_err else None
             if zero1 is not None:
                 hstats = zero1.health_stats(
                     loss=loss, grad_shards=gshards, params=state.params,
                     update_shards=ushards, per_layer=health.per_layer,
+                    compress_error_sq=err_sq,
                 )
-                new_params, new_opt = guard_step(
-                    health, hstats, (new_params, new_opt),
-                    (state.params, state.opt_state),
+                (new_params, new_opt, new_residual) = guard_step(
+                    health, hstats, (new_params, new_opt, new_residual),
+                    (state.params, state.opt_state, state.grad_residual),
                 )
                 metrics["health"] = hstats
             else:
@@ -228,14 +284,19 @@ def make_sp_lm_train_step(
                     health, loss=loss, grads=grads, params=state.params,
                     updates=updates, new_params=new_params,
                     new_opt_state=new_opt, old_opt_state=state.opt_state,
+                    compress_error_sq=err_sq,
                 )
+                if ef:
+                    (new_residual,) = guard_step(
+                        health, metrics["health"], (new_residual,),
+                        (state.grad_residual,))
         return (
             state.replace(step=state.step + 1, params=new_params,
-                          opt_state=new_opt),
+                          opt_state=new_opt, grad_residual=new_residual),
             metrics,
         )
 
-    state_specs = zero1.state_specs() if zero1 is not None else P()
+    state_specs = state_specs_for(zero1, compress, data_axis)
     sharded = jax.shard_map(
         shard_step, mesh=mesh,
         in_specs=(state_specs, {"tokens": P(data_axis, seq_axis)}),

@@ -20,6 +20,12 @@ class TrainState(struct.PyTreeNode):
     params: Any
     batch_stats: Any
     opt_state: Any
+    # --grad-compress error-feedback residual (parallel/compression.py):
+    # per-device quantization error carried step-to-step, one
+    # (n_shards, padded) f32 leaf per param leaf laid out P(data) — None
+    # (an empty subtree) everywhere else, so every existing construction
+    # site and checkpoint stays byte-identical without the feature.
+    grad_residual: Any = None
 
 
 def init_model_variables(model, rng, input_shape=(1, 32, 32, 3)) -> tuple:

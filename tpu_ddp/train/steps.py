@@ -78,6 +78,38 @@ def resolve_remat(model, remat: bool):
 Batch = dict
 
 
+def state_specs_for(zero1, compress, data_axis: str = DATA_AXIS):
+    """shard_map in/out specs for the TrainState under the optional state
+    layouts: ZeRO-1 scatters the optimizer state (``zero1.state_specs``),
+    and --grad-compress error feedback adds the per-device residual
+    (``TrainState.grad_residual``, leading axis over ``data``). Plain
+    replicated state stays the bare ``P()`` prefix so those builds trace
+    byte-identical to before either feature existed."""
+    ef = compress is not None and compress.config.error_feedback
+    if zero1 is None and not ef:
+        return P()
+    base = (zero1.state_specs() if zero1 is not None
+            else TrainState(step=P(), params=P(), batch_stats=P(),
+                            opt_state=P()))
+    if ef:
+        base = base.replace(grad_residual=P(data_axis))
+    return base
+
+
+def _bind_compressor(zero1, compress):
+    """ZeRO-1 + compression compose by the partition delegating its
+    reduce-scatter to the compressor's ring — make sure the two agree on
+    ONE compressor object (idempotent; trainer/strategy normally attach
+    it at construction, tests may pass both separately)."""
+    if zero1 is not None and compress is not None:
+        if zero1.compress is None:
+            zero1.set_compression(compress)
+        elif zero1.compress is not compress:
+            raise ValueError(
+                "zero1 partition already carries a different GradCompressor"
+            )
+
+
 def _make_shard_step(
     model,
     tx: optax.GradientTransformation,
@@ -92,9 +124,19 @@ def _make_shard_step(
     aux_weight: float = 0.01,
     health: Optional[HealthConfig] = None,
     zero1=None,
+    compress=None,
 ):
     """Per-shard train-step body shared by the single-step and scanned
     variants: forward, pmean'd loss (the gradient allreduce), optax update.
+
+    ``compress`` (a ``tpu_ddp.parallel.compression.GradCompressor``)
+    swaps the gradient sync's wire format: without zero1 the pmean
+    becomes a block-scaled quantized ring all-reduce (f32 accumulation
+    on-device, int8/bf16 payloads on the wire — ~4x/2x fewer gradient
+    bytes per hop); with zero1 the partition's reduce-scatter runs the
+    same quantized ring. Error feedback, when configured, carries each
+    device's quantization error in ``state.grad_residual`` and adds it
+    back next step.
 
     ``zero1`` (a ``tpu_ddp.parallel.zero.Zero1Partition``) swaps the
     replicated update for ZeRO-1 weight-update sharding: the grad pmean
@@ -120,6 +162,7 @@ def _make_shard_step(
     task loss; the aux term appears as its own metric when present."""
 
     model, remat = resolve_remat(model, remat)
+    _bind_compressor(zero1, compress)
 
     def apply_model(params, batch_stats, images):
         return model.apply(
@@ -156,7 +199,9 @@ def _make_shard_step(
         # Under zero1 the sync is the reduce-scatter in sharded_update, so
         # the loss must stay LOCAL in both modes (modern jax differentiates
         # w.r.t. pcast-varying params instead — zero1.varying below).
-        if GRAD_SYNC_IN_AD and zero1 is None:
+        # Under --grad-compress the sync is the quantized ring, which AD
+        # cannot own either — same local-loss convention.
+        if GRAD_SYNC_IN_AD and zero1 is None and compress is None:
             loss = lax.pmean(loss, data_axis)
         return loss, (mutated.get("batch_stats", batch_stats), logits, task, aux)
 
@@ -181,24 +226,43 @@ def _make_shard_step(
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
         # named scopes label the XLA ops so a jax.profiler device trace
         # (and the telemetry Chrome trace next to it) read the same phases
-        p_in = zero1.varying(state.params) if zero1 is not None else state.params
+        if zero1 is not None:
+            p_in = zero1.varying(state.params)
+        elif compress is not None:
+            p_in = compress.varying(state.params)
+        else:
+            p_in = state.params
         with jax.named_scope("tpu_ddp.forward_backward"):
             (_, (new_stats, logits, task, aux)), grads = grad_fn(
                 p_in, state.batch_stats, batch
             )
         new_stats = jax.tree.map(lambda s: lax.pmean(s, data_axis), new_stats)
+        # error feedback reads/writes state.grad_residual; the error is
+        # also computed (without being carried) whenever health wants the
+        # compression-drift stat
+        ef = compress is not None and compress.config.error_feedback
+        want_err = compress is not None and (ef or health is not None)
+        residual = state.grad_residual if ef else None
+        err_state = None
         if zero1 is not None:
             # ZeRO-1: reduce-scatter IS the gradient sync; the optimizer
             # consumes only this shard's slice of grads/params/opt state
             # and the updated params come back via one all-gather.
             with jax.named_scope("tpu_ddp.optimizer_update"):
-                new_params, new_opt_state, gshards, ushards = (
+                new_params, new_opt_state, gshards, ushards, err_state = (
                     zero1.sharded_update(
-                        grads, state.params, state.opt_state
+                        grads, state.params, state.opt_state,
+                        residual=residual, with_error=want_err,
                     )
                 )
         else:
-            if not GRAD_SYNC_IN_AD:
+            if compress is not None:
+                # the quantized ring replaces the pmean in BOTH jax sync
+                # modes (the loss stayed local above)
+                with jax.named_scope("tpu_ddp.grad_compress_ring"):
+                    grads, err_state = compress.all_reduce_mean(
+                        grads, residual, with_error=want_err)
+            elif not GRAD_SYNC_IN_AD:
                 grads = jax.tree.map(
                     lambda g: lax.pmean(g, data_axis), grads)
             with jax.named_scope("tpu_ddp.optimizer_update"):
@@ -206,33 +270,39 @@ def _make_shard_step(
                     grads, state.opt_state, state.params
                 )
                 new_params = optax.apply_updates(state.params, updates)
+        new_residual = err_state if ef else state.grad_residual
         if health is not None:
             # grads/updates are the synchronized values in EVERY sync mode
-            # (AD-of-pmean'd-loss, the explicit pmean, or the zero1 shards
-            # whose shard-local norms are psum'd over data), so every
-            # shard computes identical global stats in-graph.
+            # (AD-of-pmean'd-loss, the explicit pmean, the dequantized
+            # ring output, or the zero1 shards whose shard-local norms are
+            # psum'd over data), so every shard computes identical global
+            # stats in-graph.
+            err_sq = (compress.error_sq(err_state)
+                      if want_err else None)
             if zero1 is not None:
                 hstats = zero1.health_stats(
                     loss=lax.pmean(task, data_axis), grad_shards=gshards,
                     params=state.params, update_shards=ushards,
-                    per_layer=health.per_layer,
+                    per_layer=health.per_layer, compress_error_sq=err_sq,
                 )
             else:
                 hstats = health_stats(
                     loss=lax.pmean(task, data_axis), grads=grads,
                     params=state.params, updates=updates,
-                    per_layer=health.per_layer,
+                    per_layer=health.per_layer, compress_error_sq=err_sq,
                 )
-            new_params, new_stats, new_opt_state = guard_step(
+            (new_params, new_stats, new_opt_state, new_residual) = guard_step(
                 health, hstats,
-                (new_params, new_stats, new_opt_state),
-                (state.params, state.batch_stats, state.opt_state),
+                (new_params, new_stats, new_opt_state, new_residual),
+                (state.params, state.batch_stats, state.opt_state,
+                 state.grad_residual),
             )
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
             batch_stats=new_stats,
             opt_state=new_opt_state,
+            grad_residual=new_residual,
         )
         metrics = {"loss": lax.pmean(task, data_axis)}
         if health is not None:
@@ -267,6 +337,7 @@ def make_train_step(
     aux_weight: float = 0.01,
     health: Optional[HealthConfig] = None,
     zero1=None,
+    compress=None,
 ) -> Callable[[TrainState, Batch], tuple]:
     """Build the compiled DDP train step for `mesh`.
 
@@ -280,6 +351,8 @@ def make_train_step(
     per device; the recipe extension the reference lacks, SURVEY.md §7.3).
     ``zero1`` (Zero1Partition) runs the ZeRO-1 sharded weight update; the
     state's opt leaves then enter/leave scattered over ``data_axis``.
+    ``compress`` (GradCompressor) quantizes the gradient sync's wire
+    payloads (--grad-compress; parallel/compression.py).
     """
     shard_step = _make_shard_step(
         model,
@@ -294,8 +367,9 @@ def make_train_step(
         aux_weight=aux_weight,
         health=health,
         zero1=zero1,
+        compress=compress,
     )
-    state_specs = zero1.state_specs() if zero1 is not None else P()
+    state_specs = state_specs_for(zero1, compress, data_axis)
     sharded = jax.shard_map(
         shard_step,
         mesh=mesh,
@@ -322,6 +396,7 @@ def make_scan_train_step(
     aux_weight: float = 0.01,
     health: Optional[HealthConfig] = None,
     zero1=None,
+    compress=None,
 ) -> Callable[[TrainState, Batch], tuple]:
     """K train steps fused into ONE dispatch via ``lax.scan``.
 
@@ -341,7 +416,8 @@ def make_scan_train_step(
     UNGATHERED: the K inner steps each reduce-scatter fresh grads, update
     their shard, and all-gather only the params (once per inner step, for
     the next forward/backward) — the shard state never re-replicates
-    inside the fused dispatch.
+    inside the fused dispatch. Under ``compress`` the error-feedback
+    residual likewise rides the carry, updated every inner step.
     """
     shard_step = _make_shard_step(
         model,
@@ -356,12 +432,13 @@ def make_scan_train_step(
         aux_weight=aux_weight,
         health=health,
         zero1=zero1,
+        compress=compress,
     )
 
     def shard_multi(state: TrainState, batches: Batch):
         return lax.scan(shard_step, state, batches, length=steps_per_call)
 
-    state_specs = zero1.state_specs() if zero1 is not None else P()
+    state_specs = state_specs_for(zero1, compress, data_axis)
     sharded = jax.shard_map(
         shard_multi,
         mesh=mesh,
@@ -385,6 +462,7 @@ def make_grad_accum_train_step(
     aux_weight: float = 0.01,
     health: Optional[HealthConfig] = None,
     zero1=None,
+    compress=None,
 ) -> Callable[[TrainState, Batch], tuple]:
     """ONE optimizer step over a global batch too large to activate at
     once: each shard splits its rows into ``accum_steps`` microbatches,
@@ -410,6 +488,7 @@ def make_grad_accum_train_step(
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
     model, remat = resolve_remat(model, remat)
+    _bind_compressor(zero1, compress)
 
     def apply_model(params, batch_stats, images):
         return model.apply(
@@ -426,9 +505,10 @@ def make_grad_accum_train_step(
         logits, mutated = apply_model(params, batch_stats, micro["image"])
         task = loss_fn(logits, micro["label"], micro.get("mask"))
         loss, aux = combine_aux_loss(task, mutated, aux_weight)
-        # grad sync, as in _make_shard_step (zero1: the sync is the
-        # reduce-scatter AFTER accumulation — the loss stays local)
-        if GRAD_SYNC_IN_AD and zero1 is None:
+        # grad sync, as in _make_shard_step (zero1/compress: the sync is
+        # the (ring) reduce-scatter AFTER accumulation — the loss stays
+        # local, ONE compressed collective per accumulated batch)
+        if GRAD_SYNC_IN_AD and zero1 is None and compress is None:
             loss = lax.pmean(loss, data_axis)
         return loss, (mutated.get("batch_stats", batch_stats), logits, task, aux)
 
@@ -445,7 +525,12 @@ def make_grad_accum_train_step(
         )
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
         zero_grads = jax.tree.map(jnp.zeros_like, state.params)
-        p_in = zero1.varying(state.params) if zero1 is not None else state.params
+        if zero1 is not None:
+            p_in = zero1.varying(state.params)
+        elif compress is not None:
+            p_in = compress.varying(state.params)
+        else:
+            p_in = state.params
 
         def accum(carry, micro):
             grads_acc, stats, correct, count, loss_sum, aux_sum = carry
@@ -477,45 +562,58 @@ def make_grad_accum_train_step(
         )
         grads = jax.tree.map(lambda g: g / accum_steps, grads_acc)
         new_stats = jax.tree.map(lambda s: lax.pmean(s, data_axis), new_stats)
+        ef = compress is not None and compress.config.error_feedback
+        want_err = compress is not None and (ef or health is not None)
+        residual = state.grad_residual if ef else None
+        err_state = None
         if zero1 is not None:
             # ONE reduce-scatter for the whole accumulated batch: the
             # microbatch mean above commutes with the cross-shard average.
-            new_params, new_opt_state, gshards, ushards = (
-                zero1.sharded_update(grads, state.params, state.opt_state)
+            new_params, new_opt_state, gshards, ushards, err_state = (
+                zero1.sharded_update(grads, state.params, state.opt_state,
+                                     residual=residual, with_error=want_err)
             )
         else:
-            if not GRAD_SYNC_IN_AD:  # see _make_shard_step: explicit sync
+            if compress is not None:  # one compressed ring per step
+                grads, err_state = compress.all_reduce_mean(
+                    grads, residual, with_error=want_err)
+            elif not GRAD_SYNC_IN_AD:  # _make_shard_step: explicit sync
                 grads = jax.tree.map(
                     lambda g: lax.pmean(g, data_axis), grads)
             updates, new_opt_state = tx.update(
                 grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
+        new_residual = err_state if ef else state.grad_residual
         if health is not None:
             # same guarantees as _make_shard_step: grads/updates are the
             # synchronized values the optimizer consumed (the accumulated
             # average), so the stats are the true full-batch numbers
+            err_sq = compress.error_sq(err_state) if want_err else None
             if zero1 is not None:
                 hstats = zero1.health_stats(
                     loss=lax.pmean(loss_sum / accum_steps, data_axis),
                     grad_shards=gshards, params=state.params,
                     update_shards=ushards, per_layer=health.per_layer,
+                    compress_error_sq=err_sq,
                 )
             else:
                 hstats = health_stats(
                     loss=lax.pmean(loss_sum / accum_steps, data_axis),
                     grads=grads, params=state.params, updates=updates,
-                    per_layer=health.per_layer,
+                    per_layer=health.per_layer, compress_error_sq=err_sq,
                 )
-            new_params, new_stats, new_opt_state = guard_step(
+            (new_params, new_stats, new_opt_state, new_residual) = guard_step(
                 health, hstats,
-                (new_params, new_stats, new_opt_state),
-                (state.params, state.batch_stats, state.opt_state),
+                (new_params, new_stats, new_opt_state, new_residual),
+                (state.params, state.batch_stats, state.opt_state,
+                 state.grad_residual),
             )
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
             batch_stats=new_stats,
             opt_state=new_opt_state,
+            grad_residual=new_residual,
         )
         metrics = {"loss": lax.pmean(loss_sum / accum_steps, data_axis)}
         if health is not None:
@@ -526,7 +624,7 @@ def make_grad_accum_train_step(
             )
         return new_state, metrics
 
-    state_specs = zero1.state_specs() if zero1 is not None else P()
+    state_specs = state_specs_for(zero1, compress, data_axis)
     sharded = jax.shard_map(
         shard_step,
         mesh=mesh,

@@ -138,6 +138,10 @@ class Strategy:
     zero1: Optional[Any] = None      # Zero1Partition when --zero1 (dp/sp):
                                      # the trainer needs it to de-shard the
                                      # opt state for checkpoints/EMA eval
+    compress: Optional[Any] = None   # GradCompressor when --grad-compress
+                                     # (dp/sp): the trainer reads its
+                                     # wire-byte accounting into the
+                                     # comm/* telemetry counters
 
 
 def _batch_shardings(mesh: Mesh, image_spec: P) -> dict:
@@ -253,6 +257,7 @@ def build_strategy(
     grad_accum_steps: int = 1,
     health=None,
     zero1: bool = False,
+    grad_compress: Optional[dict] = None,
 ) -> Strategy:
     """Build the full strategy for any non-dp mode on a prebuilt mesh. (The
     dp path stays in Trainer: its shard_map step, scan fusion, and
@@ -278,6 +283,12 @@ def build_strategy(
     the Trainer; sp here). The GSPMD family rejects it: fsdp/fsdp_tp
     already scatter the optimizer state (ZeRO-3 subsumes ZeRO-1), and
     tp/pp/ep lay their state out by their own partition rules.
+
+    ``grad_compress`` (``--grad-compress``; a
+    ``{"mode", "block", "error_feedback"}`` dict) quantizes the DP-family
+    gradient sync's wire payloads (parallel/compression.py) — same
+    family guards as zero1: fsdp/tp/pp/ep reject, because their gradient
+    movement is GSPMD-partitioner-internal, not a pmean this router owns.
     """
     from tpu_ddp.parallel.partitioning import shard_train_state
     from tpu_ddp.train.steps import make_eval_step, make_predict_step
@@ -298,6 +309,13 @@ def build_strategy(
             "subsumes ZeRO-1), and tp/pp/ep own their state layout. Use "
             "--zero1 with dp or sp."
         )
+    if grad_compress and parallelism not in ("dp", "sp"):
+        raise ValueError(
+            f"--grad-compress is not supported with --parallelism "
+            f"{parallelism}: the fsdp/tp/pp/ep families' gradient "
+            "movement is GSPMD-internal, not a pmean this router owns. "
+            "Use --grad-compress with dp or sp."
+        )
 
     if parallelism == "sp":
         _require_model(model, ("vit",), "sp")
@@ -312,6 +330,7 @@ def build_strategy(
         # are identical by construction (models/vit.py docstring).
         state = initial_state or create_train_state(plain, tx, rng)
         part = None
+        comp = None
         state_shardings = None
         if zero1:
             from tpu_ddp.parallel.zero import Zero1Partition
@@ -321,8 +340,32 @@ def build_strategy(
             state_shardings = part.state_shardings(state, mesh)
         else:
             state = jax.device_put(state, replicated)
+        if grad_compress:
+            from tpu_ddp.parallel.compression import (
+                GradCompression,
+                GradCompressor,
+            )
+
+            comp = GradCompressor(
+                GradCompression(**grad_compress), state.params, data_size,
+                axis=DATA_AXIS,
+            )
+            if part is not None:
+                part.set_compression(comp)
+            if comp.config.error_feedback:
+                # residual scattered over data, replicated over sequence
+                state = state.replace(
+                    grad_residual=comp.init_residual(mesh))
+                if state_shardings is None:
+                    rep = replicated
+                    state_shardings = jax.tree.map(
+                        lambda _: rep,
+                        state.replace(grad_residual=None))
+                state_shardings = state_shardings.replace(
+                    grad_residual=comp.residual_shardings(mesh))
         step = make_sp_train_step(
-            sp_model, tx, mesh, loss_fn=loss_fn, health=health, zero1=part)
+            sp_model, tx, mesh, loss_fn=loss_fn, health=health, zero1=part,
+            compress=comp)
         # Eval/predict also run the plain module: attention math is the
         # same, so the standard shard_map eval replicates over the sequence
         # axis and stays exact.
@@ -338,6 +381,7 @@ def build_strategy(
             state_shardings=state_shardings,
             data_size=data_size,
             zero1=part,
+            compress=comp,
         )
 
     if parallelism == "pp":
