@@ -4,7 +4,8 @@
 PYTHON ?= python
 
 .PHONY: test test-all dryrun bench smoke capture aot real-data lint \
-	trace-demo health-demo zero-demo compress-demo
+	trace-demo health-demo zero-demo compress-demo analyze-demo \
+	bench-compare
 
 # Fast default loop (round-3 verdict item 5): skips the `slow`-marked
 # multi-process / end-to-end-CLI / AOT tests. CI and pre-commit should run
@@ -98,6 +99,32 @@ zero-demo:
 compress-demo:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
 	  $(PYTHON) -m tpu_ddp.tools.compress_demo --devices 4
+
+# Step-time anatomy acceptance (docs/analysis.md): a short CPU run with
+# telemetry, then `tpu-ddp analyze <run_dir>` must rebuild the exact
+# program from the run-metadata header, classify the roofline bound
+# (attributed against the v5e chip spec), render the collective
+# inventory, and join the measured phases; every strategy's compiled
+# step must match its pinned collective fingerprint; and the
+# `bench compare` gate must flag injected inventory drift. Exits
+# non-zero on any miss (tpu_ddp/tools/analyze_demo.py).
+ANALYZE_DEMO_DIR ?= /tmp/tpu_ddp_analyze_demo
+analyze-demo:
+	rm -rf $(ANALYZE_DEMO_DIR)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+	  $(PYTHON) -m tpu_ddp.tools.analyze_demo --dir $(ANALYZE_DEMO_DIR)
+
+# Deviceless perf-regression gate: re-capture the AOT artifact with the
+# real XLA:TPU toolchain (needs libtpu; ~30+ min of compiles) and diff
+# it against the committed baseline — exits nonzero on an extra
+# collective, a widened payload dtype, or memory/flops growth beyond
+# tolerance. `make aot` rewrites benchmarks/aot_v5e.json in place, so
+# the baseline is snapshotted first.
+bench-compare:
+	cp benchmarks/aot_v5e.json /tmp/tpu_ddp_aot_baseline.json
+	$(PYTHON) benchmarks/aot_v5e.py
+	$(PYTHON) -m tpu_ddp.cli.main bench compare --tolerance 0.1 \
+	  /tmp/tpu_ddp_aot_baseline.json benchmarks/aot_v5e.json
 
 # 2-epoch end-to-end CLI run on the virtual mesh (fast sanity check).
 smoke:

@@ -63,46 +63,51 @@ def _mem(compiled) -> dict:
     return out
 
 
-_HLO_OPS = ("all-reduce", "all-gather", "reduce-scatter",
-            "collective-permute", "all-to-all", "convolution", "fusion",
-            "custom-call")
-
-
 def _hlo_ops(compiled) -> dict:
     """INSTRUCTION counts of the load-bearing ops in the OPTIMIZED HLO —
     where the sharding design becomes visible (DP shows the bucketed grad
     all-reduce, PP its collective-permute rotation, EP the token
-    all-to-all, the Pallas kernels their custom-calls). Counts opcode
-    definition sites (`= <type> <opcode>(`): raw substring counts would be
-    inflated by instruction names, operand uses, and -start/-done async
-    variants."""
-    import re
+    all-to-all, the Pallas kernels their custom-calls). Shared
+    implementation: tpu_ddp/analysis/hlo.py counts opcode definition
+    sites only (raw substring counts would be inflated by instruction
+    names, operand uses, and -start/-done async variants)."""
+    from tpu_ddp.analysis.hlo import hlo_op_counts
+
+    try:
+        return hlo_op_counts(compiled.as_text())
+    except Exception:
+        return {}
+
+
+def _collective_inventory(compiled) -> dict:
+    """The full (kind x dtype) collective inventory with payload bytes,
+    via the shared extraction (tpu_ddp/analysis/hlo.py) — the structure
+    ``tpu-ddp bench compare`` diffs, so an extra all-gather or a widened
+    payload dtype in ANY program fails the gate. (No mesh is threaded
+    here, so the axis slot reads "unknown"; kind/dtype/count/bytes are
+    the drift-sensitive fields.)"""
+    from tpu_ddp.analysis.hlo import extract_collectives
 
     try:
         txt = compiled.as_text()
     except Exception:
         return {}
-    # An opcode definition site reads `= <result-type> <opcode>(`; the
-    # result type ends with `]` (array), `}` (layout), or `)` (tuple — how
-    # bucketed collectives appear), so anchor on that instead of \S+
-    # (which misses tuple types containing spaces).
-    found = re.findall(
-        r"[\]})] (" + "|".join(_HLO_OPS) + r")(?:-start)?\(", txt
-    )
-    out: dict = {}
-    for op in found:
-        out[op] = out.get(op, 0) + 1
-    return out
+    inventory = {}
+    for c in extract_collectives(txt):
+        inventory[c.key()] = {
+            "count": c.count, "payload_bytes": c.payload_bytes,
+            "wire_bytes": c.wire_bytes, "group_size": c.group_size,
+        }
+    return {"inventory": inventory} if inventory else {}
 
 
 def _int8_collective_bytes(compiled) -> dict:
-    """Per-hop payload evidence for --grad-compress int8: every
-    collective-permute in the optimized HLO whose operand is s8 (the
-    quantized ring hops), with total payload bytes, next to the f32
-    collective-permute bytes (scales + any uncompressed rings) — the
-    compiler's own confirmation that the gradient ring moves int8, not
-    f32, per hop."""
-    import re
+    """Per-hop payload evidence for --grad-compress int8: the s8-operand
+    collective-permutes (quantized ring hops) next to the f32 ones
+    (scales + any uncompressed rings) — the compiler's own confirmation
+    that the gradient ring moves int8, not f32, per hop. Derived from the
+    shared inventory; keys kept stable for artifact compatibility."""
+    from tpu_ddp.analysis.hlo import extract_collectives
 
     try:
         txt = compiled.as_text()
@@ -110,24 +115,10 @@ def _int8_collective_bytes(compiled) -> dict:
         return {}
     out = {"s8_collective_permute_count": 0, "s8_payload_bytes": 0,
            "f32_collective_permute_count": 0, "f32_payload_bytes": 0}
-    # operand-typed definition sites, sync and async: e.g.
-    #   %x = s8[1622528]{0} collective-permute(...)
-    #   %y = (s8[...], s8[...]) collective-permute-start(...)
-    for dtype, count_key, bytes_key, width in (
-        ("s8", "s8_collective_permute_count", "s8_payload_bytes", 1),
-        ("f32", "f32_collective_permute_count", "f32_payload_bytes", 4),
-    ):
-        for m in re.finditer(
-            rf"= \(?({dtype}\[[0-9,]*\])[^=]*? "
-            r"collective-permute(?:-start)?\(", txt
-        ):
-            dims = m.group(1)[len(dtype) + 1:-1]
-            n = 1
-            for d in dims.split(","):
-                if d:
-                    n *= int(d)
-            out[count_key] += 1
-            out[bytes_key] += n * width
+    for c in extract_collectives(txt):
+        if c.kind == "collective-permute" and c.dtype in ("s8", "f32"):
+            out[f"{c.dtype}_collective_permute_count"] += c.count
+            out[f"{c.dtype}_payload_bytes"] += c.payload_bytes
     return out
 
 
@@ -140,6 +131,7 @@ def _compile(name: str, fn_trace, extra=None) -> dict:
         ops = _hlo_ops(compiled)
         if ops:
             rec["hlo_ops"] = ops
+        rec.update(_collective_inventory(compiled))
         if extra is not None:
             rec.update(extra(compiled))
     except Exception as e:  # record the failure; keep compiling the rest

@@ -1,0 +1,51 @@
+"""Static performance analysis: the compiler as observability source.
+
+``analysis/hlo.py`` turns one compiled train step into a schema-versioned
+:class:`StepAnatomy` (cost-model flops, HBM bytes, fusion count, full
+collective inventory); ``analysis/roofline.py`` holds the single chip-spec
+table and attributes an anatomy into compute/HBM/ICI time terms with a
+bound classification; ``analysis/explain.py`` is ``tpu-ddp analyze``
+(static report + measured-telemetry join + per-strategy collective
+fingerprints); ``analysis/regress.py`` is ``tpu-ddp bench compare`` (the
+deviceless CI perf-regression gate). See docs/analysis.md.
+"""
+
+from tpu_ddp.analysis.hlo import (
+    ANATOMY_SCHEMA_VERSION,
+    Collective,
+    StepAnatomy,
+    cached_compile,
+    clear_compile_cache,
+    compile_cache_stats,
+    extract_anatomy,
+    extract_collectives,
+    hlo_op_counts,
+)
+from tpu_ddp.analysis.roofline import (
+    CHIP_SPECS,
+    ChipSpec,
+    RooflineReport,
+    chip_spec,
+    hbm_bytes_per_chip,
+    peak_flops_per_chip,
+    roofline,
+)
+
+__all__ = [
+    "ANATOMY_SCHEMA_VERSION",
+    "Collective",
+    "StepAnatomy",
+    "cached_compile",
+    "clear_compile_cache",
+    "compile_cache_stats",
+    "extract_anatomy",
+    "extract_collectives",
+    "hlo_op_counts",
+    "CHIP_SPECS",
+    "ChipSpec",
+    "RooflineReport",
+    "chip_spec",
+    "hbm_bytes_per_chip",
+    "peak_flops_per_chip",
+    "roofline",
+]

@@ -1,0 +1,288 @@
+"""``tpu-ddp bench compare old.json new.json`` — deviceless perf gate.
+
+Structured diff of two bench/AOT/analyze artifacts, built to catch the
+regressions that matter BEFORE a TPU run, on CPU, in CI:
+
+- an **extra collective** (one more all-gather in the optimized HLO than
+  the pinned artifact has) — how a parallelism/layout bug usually lands;
+- a **widened payload dtype** (an f32 collective where the artifact had
+  s8 — the ``--grad-compress`` ring silently degrading);
+- **memory growth** (argument/temp bytes up beyond ``--tolerance``);
+- **cost-model growth** (flops / bytes-accessed up beyond tolerance).
+
+COLLECTIVE counts compare exactly (an extra collective is never noise);
+compiler-decision counts (fusion / convolution / custom-call) and sized
+metrics compare with a relative tolerance (compiler-version jitter on
+fusion choices and temp bytes is real). Wall-clock fields
+(``compile_wall_s``) are reported, never gated — they measure the build
+machine, not the program.
+
+Understands three artifact shapes: ``benchmarks/aot_v5e.json``-style
+(``{"programs": {name: record}}``), ``tpu-ddp analyze --json`` output
+(``{"anatomy": ...}``), and a bare single program record. Stdlib-only —
+no jax import — so it gates anywhere the JSON lands.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+#: sized metrics where LOWER IS BETTER; relative increase > tolerance is
+#: a regression (absolute increases under 1 KiB are ignored as noise)
+_SIZE_KEYS = (
+    "argument_size_in_bytes", "output_size_in_bytes", "temp_size_in_bytes",
+    "generated_code_size_in_bytes", "s8_payload_bytes", "f32_payload_bytes",
+    "argument_bytes", "output_bytes", "temp_bytes", "peak_bytes",
+    "flops", "bytes_accessed",
+)
+_SIZE_NOISE_FLOOR = 1024
+
+#: count metrics (exact): any increase is a regression
+_COUNT_KEYS = ("s8_collective_permute_count", "f32_collective_permute_count")
+
+#: opcodes whose counts are COLLECTIVES — exact-gated (an extra one is a
+#: layout change, never noise). Mirrors analysis/hlo.py::COLLECTIVE_OPS
+#: (duplicated so this module stays import-free of the jax-adjacent code)
+_COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                   "collective-permute", "all-to-all")
+
+#: counts that are COMPILER decisions (fusion/conv/custom-call counts
+#: move on any XLA version bump): tolerance-gated, not exact
+_SOFT_COUNT_KEYS = ("fusion_count",)
+
+_WALL_KEYS = ("compile_wall_s",)
+
+
+def load_artifact(path: str) -> Dict[str, dict]:
+    """Normalize an artifact file into ``{program_name: record}``."""
+    with open(path) as f:
+        art = json.load(f)
+    if not isinstance(art, dict):
+        raise ValueError(f"{path}: expected a JSON object artifact")
+    if isinstance(art.get("programs"), dict):
+        return {name: rec for name, rec in art["programs"].items()
+                if isinstance(rec, dict)}
+    if isinstance(art.get("anatomy"), dict):
+        name = art["anatomy"].get("strategy", "anatomy")
+        return {name: art["anatomy"]}
+    return {"program": art}
+
+
+def _inventory(rec: dict) -> Optional[Dict[str, dict]]:
+    """The record's collective inventory, normalized to
+    ``{"kind/dtype/axis/gN": entry}``; None when the record predates
+    inventories (the pre-inventory ``aot_v5e.json`` schema) — callers
+    must treat that as "no baseline", not "zero collectives"."""
+    if isinstance(rec.get("inventory"), dict):
+        return rec["inventory"]
+    if isinstance(rec.get("collectives"), list):
+        return {
+            f"{c.get('kind')}/{c.get('dtype')}/{c.get('axis')}"
+            f"/g{c.get('group_size', 0)}": c
+            for c in rec["collectives"]
+        }
+    return None
+
+
+def _counts(rec: dict) -> Dict[str, int]:
+    """All exact-compare counters of a record: explicit count keys, the
+    COLLECTIVE rows of the ``hlo_ops`` opcode table, and
+    per-(kind/dtype/axis/gN) inventory counts."""
+    out: Dict[str, int] = {}
+    for key in _COUNT_KEYS:
+        if isinstance(rec.get(key), (int, float)):
+            out[key] = int(rec[key])
+    for op, n in (rec.get("hlo_ops") or {}).items():
+        if op in _COLLECTIVE_OPS:
+            out[f"hlo_ops/{op}"] = int(n)
+    for key, entry in (_inventory(rec) or {}).items():
+        if isinstance(entry, dict) and "count" in entry:
+            out[f"inventory/{key}"] = int(entry["count"])
+    return out
+
+
+def _soft_counts(rec: dict) -> Dict[str, int]:
+    """Counts that are compiler decisions, not layout facts — fusion /
+    convolution / custom-call counts jitter across XLA versions, so they
+    gate with the relative tolerance instead of exactly."""
+    out: Dict[str, int] = {}
+    for key in _SOFT_COUNT_KEYS:
+        if isinstance(rec.get(key), (int, float)):
+            out[key] = int(rec[key])
+    for op, n in (rec.get("hlo_ops") or {}).items():
+        if op not in _COLLECTIVE_OPS:
+            out[f"hlo_ops/{op}"] = int(n)
+    return out
+
+
+def _sizes(rec: dict) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for key in _SIZE_KEYS:
+        if isinstance(rec.get(key), (int, float)):
+            out[key] = float(rec[key])
+    for key, entry in (_inventory(rec) or {}).items():
+        if isinstance(entry, dict):
+            for field in ("payload_bytes", "wire_bytes"):
+                if isinstance(entry.get(field), (int, float)):
+                    out[f"inventory/{key}/{field}"] = float(entry[field])
+    return out
+
+
+def compare(old: Dict[str, dict], new: Dict[str, dict],
+            *, tolerance: float = 0.05) -> dict:
+    """Diff two normalized artifacts. Returns ``{regressions,
+    improvements, notes}`` — nonempty ``regressions`` must fail the
+    caller (exit 1)."""
+    regressions: List[str] = []
+    improvements: List[str] = []
+    notes: List[str] = []
+
+    for name in sorted(old):
+        if name not in new:
+            regressions.append(f"{name}: program missing from new artifact")
+    for name in sorted(new):
+        if name not in old:
+            if new[name].get("ok") is False:
+                regressions.append(
+                    f"{name}: new program's compile is broken: "
+                    f"{str(new[name].get('error', '?'))[:120]}"
+                )
+            else:
+                notes.append(f"{name}: new program (no baseline)")
+
+    for name in sorted(set(old) & set(new)):
+        o, n = old[name], new[name]
+        if o.get("ok") is True and n.get("ok") is False:
+            regressions.append(
+                f"{name}: compile broke (ok true -> false): "
+                f"{n.get('error', '?')[:120]}"
+            )
+            continue
+        oc, nc = _counts(o), _counts(n)
+        # a baseline that predates inventories (the pre-inventory
+        # aot_v5e.json schema) has NO inventory baseline — gating its
+        # inventory/* keys would read every entry of a fresh capture as
+        # 0 -> N "extra collectives". The REVERSE asymmetry is a
+        # regression, not an improvement: a fresh capture that LOST its
+        # inventory means the extraction broke, and reading its entries
+        # as N -> 0 wins would fail the gate open exactly when the net
+        # it depends on regressed.
+        old_has_inventory = _inventory(o) is not None
+        new_has_inventory = _inventory(n) is not None
+        if old_has_inventory and not new_has_inventory:
+            regressions.append(
+                f"{name}: collective inventory missing from new artifact "
+                "(extraction broke?) — baseline had one"
+            )
+        noted_fresh_inventory = False
+        for key in sorted(set(oc) | set(nc)):
+            ov, nv = oc.get(key, 0), nc.get(key, 0)
+            if key.startswith("inventory/"):
+                if not old_has_inventory:
+                    if not noted_fresh_inventory:
+                        notes.append(
+                            f"{name}: baseline has no collective "
+                            "inventory (pre-inventory schema); inventory "
+                            "gates start with the new artifact"
+                        )
+                        noted_fresh_inventory = True
+                    continue
+                if not new_has_inventory:
+                    continue  # already flagged wholesale above
+            if nv > ov:
+                kind = "extra collective" if key.startswith("inventory/") \
+                    else "count increase"
+                regressions.append(
+                    f"{name}: {key}: {ov} -> {nv} ({kind})"
+                )
+            elif nv < ov:
+                improvements.append(f"{name}: {key}: {ov} -> {nv}")
+        osc, nsc = _soft_counts(o), _soft_counts(n)
+        for key in sorted(set(osc) & set(nsc)):
+            ov, nv = osc[key], nsc[key]
+            if nv > ov * (1 + tolerance) and nv > ov + 2:
+                regressions.append(
+                    f"{name}: {key}: {ov} -> {nv} (compiler-count growth "
+                    f"beyond tolerance {tolerance:.0%})"
+                )
+            elif ov > nv * (1 + tolerance) and ov > nv + 2:
+                improvements.append(f"{name}: {key}: {ov} -> {nv}")
+        osz, nsz = _sizes(o), _sizes(n)
+        for key in sorted(set(osz) | set(nsz)):
+            ov, nv = osz.get(key), nsz.get(key)
+            if ov is None:
+                # a fresh inventory payload entry whose count didn't also
+                # appear above means a baseline without inventories; the
+                # count rule already gates real new-collective cases
+                continue
+            if nv is None:
+                if key.startswith("inventory/") and new_has_inventory:
+                    improvements.append(f"{name}: {key}: gone")
+                continue
+            if nv > ov + _SIZE_NOISE_FLOOR and nv > ov * (1 + tolerance):
+                # ov can be 0 (e.g. a wire_bytes entry whose groups failed
+                # to parse): still a regression, just no percent to quote
+                delta = (f"+{(nv - ov) / ov:.1%}" if ov else "from 0")
+                regressions.append(
+                    f"{name}: {key}: {ov:.0f} -> {nv:.0f} "
+                    f"({delta}, tolerance {tolerance:.0%})"
+                )
+            elif ov > nv + _SIZE_NOISE_FLOOR and ov > nv * (1 + tolerance):
+                improvements.append(
+                    f"{name}: {key}: {ov:.0f} -> {nv:.0f} "
+                    f"(-{(ov - nv) / ov:.1%})"
+                )
+        for key in _WALL_KEYS:
+            ov, nv = o.get(key), n.get(key)
+            if isinstance(ov, (int, float)) and isinstance(nv, (int, float)) \
+                    and ov and abs(nv - ov) > 0.5 * ov:
+                notes.append(
+                    f"{name}: {key}: {ov} -> {nv} (informational — wall "
+                    "clock measures the build machine)"
+                )
+    return {"regressions": regressions, "improvements": improvements,
+            "notes": notes}
+
+
+def render(result: dict, old_path: str, new_path: str) -> str:
+    lines = [f"bench compare: {old_path} -> {new_path}"]
+    for label, key in (("REGRESSIONS", "regressions"),
+                       ("improvements", "improvements"),
+                       ("notes", "notes")):
+        entries = result[key]
+        if not entries:
+            continue
+        lines.append(f"{label} ({len(entries)}):")
+        lines.extend(f"  {e}" for e in entries)
+    if not result["regressions"]:
+        lines.append("no regressions")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``tpu-ddp bench compare old.json new.json [--tolerance 0.05]``"""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="tpu-ddp bench compare",
+        description="structured diff of two bench/AOT/analyze artifacts; "
+                    "exits 1 on any regression (extra collectives, "
+                    "widened payload dtypes, memory/flops growth)",
+    )
+    ap.add_argument("old", help="baseline artifact (the committed JSON)")
+    ap.add_argument("new", help="freshly captured artifact")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="relative growth allowed on sized metrics and "
+                         "compiler-decision counts (default 0.05); "
+                         "collective counts always compare exactly")
+    args = ap.parse_args(list(argv) if argv is not None else None)
+    try:
+        old = load_artifact(args.old)
+        new = load_artifact(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"tpu-ddp bench compare: {e}", flush=True)
+        return 2
+    result = compare(old, new, tolerance=args.tolerance)
+    print(render(result, args.old, args.new), flush=True)
+    return 1 if result["regressions"] else 0

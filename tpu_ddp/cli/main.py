@@ -10,11 +10,19 @@ Subcommands:
 - ``tpu-ddp health <run_dir>`` — render a monitored run's numerics
   timeline (loss/grad-norm percentiles + sparkline, non-finite and
   loss-spike steps) and any anomaly dumps (docs/health.md).
+- ``tpu-ddp analyze [run_dir]`` — static step-time anatomy: XLA
+  cost-model flops/bytes, collective inventory, roofline bound
+  classification, per-strategy collective fingerprint; given a run dir,
+  joins the measured telemetry (achieved-vs-roofline, MFU, data-wait
+  share). Compiles the real step, so it needs jax (docs/analysis.md).
+- ``tpu-ddp bench compare old.json new.json`` — structured diff of two
+  bench/AOT/analyze artifacts; exits 1 on regressions (extra
+  collectives, widened payload dtypes, memory/flops growth).
 
-``trace summarize`` and ``health`` are stdlib-only end to end (no jax
-import): records are summarized wherever they land — a laptop, a CI box,
-the pod host itself. The train/launch subcommands import lazily so the
-read-back commands keep that property.
+``trace summarize``, ``health``, and ``bench compare`` are stdlib-only
+end to end (no jax import): records are summarized wherever they land —
+a laptop, a CI box, the pod host itself. The train/launch/analyze
+subcommands import lazily so the read-back commands keep that property.
 """
 
 from __future__ import annotations
@@ -59,6 +67,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_ddp.cli.launch import main as launch_main
 
         return launch_main(argv[1:])
+    # analyze / bench own their argparse surfaces (like train/launch):
+    # hand the remainder through so their --help shows the full surface
+    if argv[:1] == ["analyze"]:
+        from tpu_ddp.analysis.explain import main as analyze_main
+
+        return analyze_main(argv[1:])
+    if argv[:2] == ["bench", "compare"]:
+        from tpu_ddp.analysis.regress import main as compare_main
+
+        return compare_main(argv[2:])
 
     ap = argparse.ArgumentParser(
         prog="tpu-ddp",
@@ -85,6 +103,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     health.add_argument("path", help="run dir (holding health-p*.jsonl) "
                                      "or a health file")
     health.set_defaults(func=_health_summarize)
+    sub.add_parser(
+        "analyze",
+        help="static step anatomy + roofline + collective fingerprint, "
+             "optionally joined with a run dir's telemetry "
+             "(tpu-ddp analyze --help)",
+    )
+    bench = sub.add_parser("bench", help="bench artifact tools")
+    bench_sub = bench.add_subparsers(dest="bench_command", required=True)
+    bench_sub.add_parser(
+        "compare",
+        help="diff two bench/AOT/analyze JSON artifacts; exit 1 on "
+             "regression (tpu-ddp bench compare --help)",
+    )
     args = ap.parse_args(argv)
     return args.func(args)
 

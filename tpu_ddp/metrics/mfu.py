@@ -21,49 +21,26 @@ from __future__ import annotations
 
 from typing import Optional
 
-# bf16 peak matmul FLOPs/sec per CHIP. Substring-matched against
-# jax.Device.device_kind (lowercased); first hit wins, so more specific
-# patterns come first.
-_PEAK_BF16_FLOPS = (
-    ("v6e", 918e12),       # Trillium
-    ("v6 lite", 918e12),
-    ("v5p", 459e12),
-    ("v5e", 197e12),
-    ("v5 lite", 197e12),
-    ("v5litepod", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-)
-
-
-def peak_flops_per_chip(device=None) -> Optional[float]:
-    """bf16 MXU peak for `device` (default: first jax device); None if the
-    device kind isn't a known TPU."""
-    import jax
-
-    if device is None:
-        device = jax.devices()[0]
-    kind = getattr(device, "device_kind", "").lower()
-    for pattern, peak in _PEAK_BF16_FLOPS:
-        if pattern in kind:
-            return peak
-    return None
+# Chip peaks live in ONE place now: the analysis chip-spec table. The
+# private copy this module used to carry had already drifted (no pattern
+# for the bare "TPU v5" device-kind string real v5p chips report, so v5p
+# runs silently got peak=None); re-exporting keeps every MFU/roofline
+# consumer on the same numbers.
+from tpu_ddp.analysis.roofline import peak_flops_per_chip  # noqa: F401
 
 
 def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
     """Total FLOPs of ONE call of `jitted(*args, **kwargs)` per XLA's cost
-    model of the compiled executable. Returns None when the backend doesn't
-    expose a cost analysis (some CPU builds) or lowering fails."""
+    model of the compiled executable (the shared probe in
+    ``analysis/hlo.py``). Returns None when the backend doesn't expose a
+    cost analysis (some CPU builds) or lowering fails."""
+    from tpu_ddp.analysis.hlo import cost_analysis_figures
+
     try:
         compiled = jitted.lower(*args, **kwargs).compile()
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, (list, tuple)):
-            analysis = analysis[0] if analysis else {}
-        flops = float(analysis.get("flops", 0.0))
-        return flops if flops > 0 else None
     except Exception:
         return None
+    return cost_analysis_figures(compiled)[0]
 
 
 def record_mfu(registry, mfu_value: Optional[float]) -> None:

@@ -21,7 +21,12 @@ any machine. See ``docs/telemetry.md``.
 """
 
 from tpu_ddp.telemetry.core import NULL, Telemetry
-from tpu_ddp.telemetry.events import SCHEMA_VERSION, Clock, Event
+from tpu_ddp.telemetry.events import (
+    RUN_META_SCHEMA_VERSION,
+    SCHEMA_VERSION,
+    Clock,
+    Event,
+)
 from tpu_ddp.telemetry.registry import (
     Registry,
     default_registry,
@@ -45,6 +50,7 @@ def build_telemetry(
     *,
     process_index: int = 0,
     jax_hooks: bool = True,
+    run_meta=None,
 ) -> Telemetry:
     """Construct a Telemetry for ``run_dir`` with the named sinks
     (comma-separated subset of ``jsonl,chrome,summary``), or the disabled
@@ -53,6 +59,12 @@ def build_telemetry(
     Per-host trace files (``trace-p<i>.jsonl`` / ``trace-p<i>.trace.json``)
     keep multihost runs collision-free in a shared run dir; the terminal
     summary only prints from process 0.
+
+    ``run_meta`` (a JSON-serializable dict: config snapshot, jax version,
+    device kind, mesh shape, strategy, schema_version) is written as the
+    first record of every file sink, so ``tpu-ddp analyze`` / ``trace
+    summarize`` can label the run — and refuse a mismatched one — instead
+    of treating run dirs as anonymous.
     """
     if not run_dir:
         return NULL
@@ -67,11 +79,12 @@ def build_telemetry(
             built.append(JsonlTraceSink(
                 os.path.join(run_dir, f"trace-p{process_index}.jsonl"),
                 clock=clock, process_index=process_index,
+                run_meta=run_meta,
             ))
         elif name == "chrome":
             built.append(ChromeTraceSink(
                 os.path.join(run_dir, f"trace-p{process_index}.trace.json"),
-                process_index=process_index,
+                process_index=process_index, run_meta=run_meta,
             ))
         elif name == "summary":
             if process_index == 0:
@@ -100,6 +113,7 @@ __all__ = [
     "Clock",
     "Event",
     "SCHEMA_VERSION",
+    "RUN_META_SCHEMA_VERSION",
     "Registry",
     "default_registry",
     "reset_default_registry",

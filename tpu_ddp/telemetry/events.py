@@ -23,6 +23,11 @@ from typing import Any, Dict, Optional
 #: the record shape; ``trace summarize`` refuses records from the future.
 SCHEMA_VERSION = 1
 
+#: Version of the run-metadata dict embedded in sink headers (config
+#: snapshot, jax version, device kind, mesh, strategy). Bump on breaking
+#: changes; ``tpu-ddp analyze`` refuses metadata from the future.
+RUN_META_SCHEMA_VERSION = 1
+
 # Event kinds
 SPAN = "span"          # a named phase with a duration
 INSTANT = "instant"    # a point event (trace written, watchdog fired, ...)

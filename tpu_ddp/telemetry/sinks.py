@@ -44,21 +44,29 @@ class Sink:
 
 class JsonlTraceSink(Sink):
     """One JSON object per line; first line is a header record carrying the
-    wall-clock anchor of the monotonic epoch (for cross-host alignment)."""
+    wall-clock anchor of the monotonic epoch (for cross-host alignment)
+    and, when provided, the RUN METADATA (config snapshot, jax version,
+    device kind, mesh shape, strategy) — what lets ``tpu-ddp analyze`` /
+    ``bench compare`` label a run and refuse a mismatched one instead of
+    treating every trace as anonymous."""
 
     def __init__(self, path: str, *, clock: Optional[Clock] = None,
-                 process_index: int = 0):
+                 process_index: int = 0,
+                 run_meta: Optional[dict] = None):
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._lock = threading.Lock()
         self._fh: Optional[TextIO] = open(path, "w")
         clock = clock or Clock()
-        self._write({
+        header = {
             "schema_version": SCHEMA_VERSION,
             "type": "header",
             "epoch_unix": clock.epoch_unix,
             "pid": process_index,
-        })
+        }
+        if run_meta:
+            header["run_meta"] = run_meta
+        self._write(header)
 
     def _write(self, record: dict) -> None:
         with self._lock:
@@ -93,7 +101,8 @@ class ChromeTraceSink(Sink):
     """
 
     def __init__(self, path: str, *, process_index: int = 0,
-                 max_events: int = 1_000_000):
+                 max_events: int = 1_000_000,
+                 run_meta: Optional[dict] = None):
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._lock = threading.Lock()
@@ -107,6 +116,14 @@ class ChromeTraceSink(Sink):
                 "args": {"name": f"tpu_ddp host {process_index}"},
             }
         ]
+        if run_meta:
+            # metadata record: Perfetto surfaces it under the track args
+            self._events.append({
+                "name": "run_meta",
+                "ph": "M",
+                "pid": process_index,
+                "args": dict(run_meta),
+            })
         self._closed = False
 
     def emit(self, event: Event) -> None:

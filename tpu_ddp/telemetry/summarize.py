@@ -11,7 +11,7 @@ from __future__ import annotations
 import glob
 import json
 import os
-from typing import Dict, Iterable, List
+from typing import Dict, Iterable, List, Optional
 
 from tpu_ddp.telemetry.events import SCHEMA_VERSION, SPAN
 from tpu_ddp.telemetry.registry import Histogram
@@ -91,6 +91,30 @@ def last_counters(records: Iterable[dict]) -> Dict[int, dict]:
     return snaps
 
 
+def run_label(records: Iterable[dict]) -> Optional[str]:
+    """One-line run identity from the metadata header the sinks write
+    (strategy / model / device / mesh / jax version); None for anonymous
+    (pre-header) traces."""
+    for rec in records:
+        if rec.get("type") == "header" and rec.get("run_meta"):
+            m = rec["run_meta"]
+            cfg = m.get("config") or {}
+            mesh = ",".join(f"{a}={s}" for a, s in (m.get("mesh") or {}).items()
+                            if s != 1)
+            parts = [
+                f"strategy={m.get('strategy', '?')}",
+                f"model={cfg.get('model', '?')}",
+                f"device={m.get('device_kind', '?')} "
+                f"x{m.get('n_devices', '?')}",
+            ]
+            if mesh:
+                parts.append(f"mesh={mesh}")
+            if m.get("jax_version"):
+                parts.append(f"jax={m['jax_version']}")
+            return "run: " + "  ".join(parts)
+    return None
+
+
 def _human_bytes(n: float) -> str:
     for unit in ("B", "KB", "MB", "GB"):
         if abs(n) < 1024:
@@ -126,11 +150,11 @@ def summarize(path: str) -> str:
     phases = aggregate_phases(records)
     if not phases:
         return f"no span records in {', '.join(files)}"
-    lines = [
-        f"trace: {', '.join(files)}",
-        "",
-        format_phase_table(phases),
-    ]
+    lines = [f"trace: {', '.join(files)}"]
+    label = run_label(records)
+    if label:
+        lines.append(label)
+    lines += ["", format_phase_table(phases)]
     snaps = last_counters(records)
     for pid in sorted(snaps):
         counters = snaps[pid]

@@ -1,0 +1,164 @@
+"""``make analyze-demo`` — end-to-end proof of the step-time anatomy.
+
+Runs on the virtual CPU mesh (no TPU), in four acts:
+
+1. a short CPU training run with telemetry on, so the run dir carries the
+   run-metadata header + measured per-phase spans;
+2. ``tpu-ddp analyze <run_dir> --chip v5e`` must rebuild the run's exact
+   program from the metadata header, classify the roofline bound, render
+   the collective inventory, and join the measured phases;
+3. every strategy's compiled step must match its pinned collective
+   fingerprint (the parallelism-correctness net: an accidental extra
+   all-gather in dp, or the int8 ring degrading to f32, fails here);
+4. the ``bench compare`` gate must actually gate: an injected extra
+   all-gather and a widened payload dtype in a copy of the analyze
+   artifact must exit nonzero.
+
+Exits non-zero if any observable outcome is missing, so CI runs it as a
+living acceptance test (alongside ``zero-demo``/``compress-demo``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="step-time anatomy demo")
+    ap.add_argument("--dir", required=True, help="run dir for telemetry")
+    ap.add_argument("--chip", default="v5e",
+                    help="chip spec to classify the bound against "
+                         "(the programs compile on CPU; the cost-model "
+                         "figures attribute onto this spec)")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tpu_ddp.analysis.explain import (
+        STRATEGIES,
+        anatomy_for_strategy,
+        check_fingerprint,
+    )
+    from tpu_ddp.analysis.explain import main as analyze_main
+    from tpu_ddp.analysis.regress import main as compare_main
+    from tpu_ddp.train.trainer import TrainConfig, Trainer
+
+    n_dev = len(jax.devices())
+    ok = True
+
+    # -- 1. a real (tiny) training run with telemetry ---------------------
+    config = TrainConfig(
+        synthetic_data=True,
+        synthetic_size=32 * n_dev * 4,
+        epochs=1,
+        per_shard_batch=32,
+        lr=1e-2,
+        model="netresdeep",
+        n_chans1=8,
+        n_blocks=2,
+        prefetch_depth=0,
+        log_every_epochs=1,
+        telemetry_dir=args.dir,
+    )
+    print(f"[analyze-demo] training 1 epoch on {n_dev} CPU devices "
+          f"(telemetry -> {args.dir})", flush=True)
+    Trainer(config).run()
+
+    # -- 2. analyze the run dir (metadata header -> rebuild -> join) ------
+    artifact = os.path.join(args.dir, "analyze.json")
+    rc = analyze_main([args.dir, "--chip", args.chip, "--json", artifact])
+    if rc != 0:
+        print(f"[analyze-demo] FAIL: tpu-ddp analyze exited {rc}",
+              file=sys.stderr)
+        ok = False
+    else:
+        with open(artifact) as f:
+            payload = json.load(f)
+        bound = payload["roofline"]["bound"]
+        inventory = payload["anatomy"]["inventory"]
+        measured = payload.get("measured", {})
+        if bound not in ("compute", "hbm", "ici"):
+            print(f"[analyze-demo] FAIL: bound not classified ({bound!r})",
+                  file=sys.stderr)
+            ok = False
+        if not inventory:
+            print("[analyze-demo] FAIL: empty collective inventory",
+                  file=sys.stderr)
+            ok = False
+        if not measured.get("step_p50_s"):
+            print("[analyze-demo] FAIL: telemetry join produced no "
+                  "measured step time", file=sys.stderr)
+            ok = False
+        if ok:
+            print(
+                f"[analyze-demo] run-dir analysis OK: bound={bound}, "
+                f"{len(inventory)} inventory entries, measured step p50 "
+                f"{measured['step_p50_s'] * 1e3:.1f} ms", flush=True,
+            )
+
+    # -- 3. every strategy's collective fingerprint -----------------------
+    failures = []
+    for strategy in STRATEGIES:
+        anatomy = anatomy_for_strategy(strategy)
+        fp = check_fingerprint(anatomy)
+        kinds = anatomy.collective_kinds()
+        print(f"[analyze-demo] fingerprint {strategy:14} "
+              f"{'OK  ' if fp['ok'] else 'FAIL'} kinds={sorted(kinds)}",
+              flush=True)
+        if not fp["ok"]:
+            failures.append((strategy, fp))
+    if failures:
+        for strategy, fp in failures:
+            print(
+                f"[analyze-demo] FAIL: {strategy}: missing="
+                f"{fp['missing']} unexpected={fp['unexpected']}",
+                file=sys.stderr,
+            )
+        ok = False
+
+    # -- 4. the compare gate must gate ------------------------------------
+    if not os.path.exists(artifact):
+        print("[analyze-demo] FAIL: analyze wrote no artifact; compare "
+              "gate not exercised", file=sys.stderr)
+        return 1
+    with open(artifact) as f:
+        base = json.load(f)
+    # clean self-compare passes
+    if compare_main([artifact, artifact]) != 0:
+        print("[analyze-demo] FAIL: self-compare reported a regression",
+              file=sys.stderr)
+        ok = False
+    # injected extra all-gather + widened payload dtype must fail
+    poisoned = copy.deepcopy(base)
+    inv = poisoned["anatomy"]["inventory"]
+    some_key = next(iter(inv))
+    inv[some_key] = dict(inv[some_key], count=inv[some_key]["count"] + 1)
+    inv[f"all-gather/f32/data/g{n_dev}"] = {
+        "count": 3, "payload_bytes": 4 << 20,
+        "wire_bytes": 3 << 20, "group_size": n_dev}
+    poisoned_path = os.path.join(args.dir, "analyze_poisoned.json")
+    with open(poisoned_path, "w") as f:
+        json.dump(poisoned, f)
+    if compare_main([artifact, poisoned_path]) != 1:
+        print("[analyze-demo] FAIL: bench compare did not flag an "
+              "injected collective regression", file=sys.stderr)
+        ok = False
+
+    if ok:
+        print(
+            "[analyze-demo] OK: bound classified, inventory rendered, "
+            f"all {len(STRATEGIES)} strategy fingerprints hold, compare "
+            f"gate fires on injected drift; inspect with: tpu-ddp "
+            f"analyze {args.dir} --chip {args.chip}"
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,16 +24,11 @@ import json
 import sys
 
 
-# HBM per chip by device kind (bytes), in the DECIMAL units the chip
-# specs are quoted in (v5e = 16 GB, v5p = 95 GB, v4 = 32 GB, v6e = 32 GB):
-# mixing GiB multipliers with decimal specs would overstate capacity and
-# flip the fit verdict near the boundary.
-_HBM_BYTES = {
-    "TPU v5 lite": 16_000_000_000,
-    "TPU v5": 95_000_000_000,
-    "TPU v4": 32 * 1024**3,  # v4 is spec'd in GiB (32 GiB), unlike v5e/v5p
-    "TPU v6 lite": 32_000_000_000,
-}
+# HBM capacity now comes from the shared chip-spec table
+# (tpu_ddp/analysis/roofline.py::CHIP_SPECS) — decimal units where the
+# chip specs are quoted decimal (v5e = 16 GB, v5p = 95 GB, v6e = 32 GB),
+# GiB for v2-v4: mixing GiB multipliers with decimal specs would overstate
+# capacity and flip the fit verdict near the boundary.
 
 
 # Layouts the planner can compile, and the non-data mesh axis each one
@@ -41,8 +36,9 @@ _HBM_BYTES = {
 # round-3 item 6 — the TP/PP/EP layouts are exactly the ones whose HBM
 # behavior is hardest to reason about by hand.
 PARALLELISMS = ("dp", "fsdp", "tp", "fsdp_tp", "pp", "ep", "sp")
-_MODE_AXIS = {"tp": "model", "fsdp_tp": "model", "pp": "pipeline",
-              "ep": "expert", "sp": "sequence"}
+# strategy -> sharded non-data axis: the shared copy lives in
+# train/strategy.py::MODE_AXIS (imported inside _plan_inner — this module
+# keeps its CLI importable without jax)
 
 
 def plan(model_name: str, per_shard_batch: int, *, compute_dtype: str,
@@ -105,14 +101,13 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
     import jax.numpy as jnp
     from jax.experimental import topologies
 
+    from tpu_ddp.analysis.hlo import cached_compile
+    from tpu_ddp.analysis.roofline import hbm_bytes_per_chip
     from tpu_ddp.models import NetResDeep
     from tpu_ddp.models.zoo import MODEL_REGISTRY
     from tpu_ddp.parallel import MeshSpec, batch_sharding, create_mesh
-    from tpu_ddp.train import (
-        create_train_state,
-        make_optimizer,
-        make_train_step,
-    )
+    from tpu_ddp.train import create_train_state, make_optimizer
+    from tpu_ddp.train.strategy import build_abstract_step
 
     topo = topologies.get_topology_desc(topology, "tpu")
     if n_devices is not None and n_devices < 1:
@@ -120,7 +115,9 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
     devices = (topo.devices[:n_devices] if n_devices is not None
                else topo.devices)
     kind = devices[0].device_kind
-    axis = _MODE_AXIS.get(parallelism)
+    from tpu_ddp.train.strategy import MODE_AXIS
+
+    axis = MODE_AXIS.get(parallelism)
     if axis is None:  # dp / fsdp: 1-D data mesh
         mesh = create_mesh(MeshSpec(data=-1), devices)
     else:
@@ -171,40 +168,28 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
             "itself; sp's ring step owns its memory story)"
         )
     zero1_report = None
-    if parallelism == "dp":
-        part = None
-        if zero1:
-            # ZeRO-1: abstract state carries the FLAT opt leaves scattered
-            # over data — the compiler's per-device argument_bytes then
-            # shows the 1/N optimizer-state shrink as ground truth, next
-            # to the layout's own static accounting below.
-            from tpu_ddp.parallel.partitioning import abstract_train_state
-            from tpu_ddp.parallel.zero import Zero1Partition
+    if zero1:
+        # Accounting only: the compiled ZeRO-1 layout itself (abstract
+        # state with the FLAT opt leaves scattered over data, whose
+        # per-device argument_bytes shows the 1/N shrink as compiler
+        # ground truth) is built inside build_abstract_step below.
+        from tpu_ddp.parallel.zero import Zero1Partition
 
-            part = Zero1Partition(tx, state.params, mesh.shape["data"])
-            state = state.replace(opt_state=part.opt_template)
-            state = abstract_train_state(
-                state, part.state_shardings(state, mesh))
-            acct = part.accounting()
-            param_bytes = sum(
-                int(jnp.prod(jnp.asarray(p.shape or (1,))))
-                * jnp.dtype(p.dtype).itemsize
-                for p in jax.tree.leaves(state.params)
-            )
-            acct["params_bytes_per_device"] = param_bytes  # replicated
-            zero1_report = acct
-        if grad_accum_steps > 1:
-            from tpu_ddp.train.steps import make_grad_accum_train_step
-
-            step = make_grad_accum_train_step(
-                model, tx, mesh, accum_steps=grad_accum_steps, remat=remat,
-                zero1=part)
-        else:
-            step = make_train_step(model, tx, mesh, remat=remat, zero1=part)
-    else:
-        step, state = _build_sharded(parallelism, model, tx, mesh, state,
-                                     axis_size, image_size, remat=remat,
-                                     grad_accum_steps=grad_accum_steps)
+        part = Zero1Partition(tx, state.params, mesh.shape["data"])
+        acct = part.accounting()
+        param_bytes = sum(
+            int(jnp.prod(jnp.asarray(p.shape or (1,))))
+            * jnp.dtype(p.dtype).itemsize
+            for p in jax.tree.leaves(state.params)
+        )
+        acct["params_bytes_per_device"] = param_bytes  # replicated
+        zero1_report = acct
+    # The shared compile-only builder (train/strategy.py): the planner's
+    # fit verdict comes from the exact step programs the product runs.
+    step, state = build_abstract_step(
+        parallelism, model, tx, mesh, image_size=image_size, remat=remat,
+        grad_accum_steps=grad_accum_steps, zero1=zero1,
+    )
 
     # batch scales with the DATA axis only: model/pipeline/expert shards
     # see the same per-data-shard batch (matches aot_v5e.py's programs)
@@ -216,12 +201,24 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
         "label": jax.ShapeDtypeStruct((gb,), jnp.int32, sharding=bs),
         "mask": jax.ShapeDtypeStruct((gb,), bool, sharding=bs),
     }
-    compiled = step.trace(state, batch).lower().compile()
+    # Process-wide compile cache (analysis/hlo.py): the wire-table /
+    # layout-sweep callers invoke plan() repeatedly with flags (like
+    # --grad-compress) that don't change the compiled program — key on
+    # exactly what does, so each distinct program compiles once.
+    cache_key = (
+        "memplan", model_name, parallelism, topology, len(devices),
+        tuple(zip(mesh.axis_names, mesh.devices.shape)), per_shard_batch,
+        image_size, num_classes, compute_dtype, remat, grad_accum_steps,
+        zero1, momentum, ema_decay,
+    )
+    compiled = cached_compile(
+        cache_key, lambda: step.trace(state, batch).lower().compile()
+    )
     ma = compiled.memory_analysis()
     arg = int(ma.argument_size_in_bytes)
     out = int(ma.output_size_in_bytes)
     temp = int(ma.temp_size_in_bytes)
-    hbm = _HBM_BYTES.get(kind)
+    hbm = hbm_bytes_per_chip(kind)
     # Steady state: donated inputs alias outputs, so peak is roughly
     # args + temp (the compiler's temp already includes the working set).
     peak = arg + temp
@@ -263,111 +260,6 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
         "fits": (peak < hbm) if hbm else None,
         "hbm_fraction": round(peak / hbm, 4) if hbm else None,
     }
-
-
-def _build_sharded(parallelism, model, tx, mesh, state, axis_size,
-                   image_size, *, remat=False, grad_accum_steps=1):
-    """(compiled-step builder, abstractified state) for the sharded
-    layouts, mirroring the exact step builders benchmarks/aot_v5e.py
-    compiles — the planner's fit verdict comes from the same programs the
-    product runs. ``state`` enters abstract (eval_shape) and leaves
-    abstract with the layout's shardings attached."""
-    import jax
-
-    from tpu_ddp.parallel.partitioning import abstract_train_state
-
-    has_bs = bool(jax.tree.leaves(state.batch_stats))
-
-    if parallelism == "fsdp":
-        # ZeRO-3: params + optimizer state scattered over the data axis —
-        # the per-device `argument_bytes` shows the 1/N state shrink with
-        # the compiler's own numbers.
-        from tpu_ddp.parallel.tensor_parallel import make_fsdp_train_step
-
-        step, shardings = make_fsdp_train_step(
-            model, tx, mesh, state, has_batch_stats=has_bs,
-            remat=remat, grad_accum_steps=grad_accum_steps,
-        )
-        return step, abstract_train_state(state, shardings)
-
-    if parallelism in ("tp", "fsdp_tp"):
-        from tpu_ddp.models.moe import MoEViT
-        from tpu_ddp.models.vit import ViT
-        from tpu_ddp.parallel.tensor_parallel import (
-            CNN_TP_RULES,
-            VIT_TP_RULES,
-            make_fsdp_tp_train_step,
-            make_tp_train_step,
-        )
-
-        rules = (VIT_TP_RULES if isinstance(model, (ViT, MoEViT))
-                 else CNN_TP_RULES)
-        mk = (make_tp_train_step if parallelism == "tp"
-              else make_fsdp_tp_train_step)
-        step, shardings = mk(model, tx, mesh, state,
-                             rules=rules, has_batch_stats=has_bs,
-                             remat=remat, grad_accum_steps=grad_accum_steps)
-        return step, abstract_train_state(state, shardings)
-
-    if parallelism == "pp":
-        from tpu_ddp.models.vit import ViT
-        from tpu_ddp.parallel.pipeline import (
-            create_pp_train_state,
-            make_pp_train_step,
-        )
-
-        if not isinstance(model, ViT):
-            raise ValueError(
-                "--parallelism pp plans the GPipe ViT pipeline; pick a "
-                "vit_* model"
-            )
-        if model.depth % axis_size:
-            raise ValueError(
-                f"pipeline stages (--axis-size {axis_size}) must divide "
-                f"model depth {model.depth}"
-            )
-        pp_state = jax.eval_shape(
-            lambda: create_pp_train_state(
-                model, tx, jax.random.key(0),
-                input_shape=(1, image_size, image_size, 3),
-            )
-        )
-        step, shardings = make_pp_train_step(
-            model, tx, mesh, pp_state, n_microbatches=2
-        )
-        return step, abstract_train_state(pp_state, shardings)
-
-    if parallelism == "ep":
-        from tpu_ddp.models.moe import MoEViT
-        from tpu_ddp.parallel.expert_parallel import make_ep_train_step
-
-        if not isinstance(model, MoEViT):
-            raise ValueError(
-                "--parallelism ep plans the expert-parallel MoE layout; "
-                "pick vit_moe_s4"
-            )
-        step, shardings = make_ep_train_step(
-            model, tx, mesh, state,
-            remat=remat, grad_accum_steps=grad_accum_steps,
-        )
-        return step, abstract_train_state(state, shardings)
-
-    if parallelism == "sp":
-        from tpu_ddp.models.vit import ViT
-        from tpu_ddp.parallel.mesh import SEQUENCE_AXIS
-        from tpu_ddp.parallel.sequence_parallel import make_sp_train_step
-
-        if not isinstance(model, ViT):
-            raise ValueError(
-                "--parallelism sp plans the ring-attention ViT layout; "
-                "pick a vit_* model"
-            )
-        step = make_sp_train_step(
-            model.clone(sp_axis=SEQUENCE_AXIS), tx, mesh
-        )
-        return step, abstract_train_state(state)
-
-    raise ValueError(f"unknown parallelism {parallelism!r}")
 
 
 def main(argv=None) -> dict:

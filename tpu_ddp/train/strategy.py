@@ -54,6 +54,17 @@ _AXIS_TO_MODE = {
     EXPERT_AXIS: "ep",
 }
 
+#: the non-data mesh axis each mode shards (the inverse of _AXIS_TO_MODE,
+#: plus the composed fsdp_tp, which shards `model`) — the one shared copy
+#: tools/memplan.py and analysis/explain.py build their meshes from
+MODE_AXIS = {
+    "tp": MODEL_AXIS,
+    "fsdp_tp": MODEL_AXIS,
+    "pp": PIPELINE_AXIS,
+    "sp": SEQUENCE_AXIS,
+    "ep": EXPERT_AXIS,
+}
+
 
 def parse_mesh_arg(text: str) -> dict:
     """'data=2,model=4' -> {'data': 2, 'model': 4}. Axes must come from the
@@ -531,3 +542,204 @@ def build_strategy(
         batch_shardings=batch_shardings, state_shardings=shardings,
         data_size=data_size,
     )
+
+
+def build_abstract_step(
+    parallelism: str,
+    model,
+    tx,
+    mesh: Mesh,
+    *,
+    image_size: int = 32,
+    remat: bool = False,
+    grad_accum_steps: int = 1,
+    zero1: bool = False,
+    grad_compress: Optional[dict] = None,
+    n_microbatches: int = 2,
+    loss_fn: Callable = cross_entropy_loss,
+    health=None,
+    pp_schedule: str = "gpipe",
+    sp_flash: bool = False,
+):
+    """(train step, ABSTRACT TrainState) for any strategy — the
+    compile-only twin of :func:`build_strategy`, shared by
+    ``tools/memplan.py``, ``analysis/hlo.py``, and ``benchmarks/``.
+    ``health``/``pp_schedule``/``sp_flash`` thread exactly like
+    :func:`build_strategy`'s — they change the compiled program, so the
+    twin must honor them too.
+
+    States are abstract end to end (``jax.eval_shape`` + the builder's
+    shardings attached via ``abstract_train_state``), so this is safe on
+    deviceless AOT topologies AND cheap on live backends: nothing here
+    materializes an array or touches a device. ``step.trace(state,
+    batch).lower().compile()`` on the result yields the exact program the
+    product trains with.
+
+    ``zero1``/``grad_compress`` (a ``{"mode", "block", "error_feedback"}``
+    dict) build the dp-family layouts — the same family guards as
+    :func:`build_strategy` apply. Returns ``(step, state)``; the dp
+    family's partition helpers are recoverable from the step's closure if
+    a caller needs accounting (memplan constructs its own).
+    """
+    import jax
+
+    from tpu_ddp.parallel.partitioning import abstract_train_state
+
+    if (remat or grad_accum_steps > 1) and parallelism in ("pp", "sp"):
+        raise ValueError(
+            "remat/grad_accum_steps are not supported with "
+            f"parallelism {parallelism!r} (pp schedules microbatches "
+            "itself; sp's ring step owns its memory story)"
+        )
+    if (zero1 or grad_compress) and parallelism != "dp":
+        raise ValueError(
+            "the abstract builder composes zero1/grad_compress with the "
+            f"dp family only, got parallelism {parallelism!r} (fsdp IS "
+            "ZeRO-3; tp/pp/ep own their layouts; live sp+zero1 routes "
+            "through build_strategy)"
+        )
+
+    if parallelism == "dp":
+        from tpu_ddp.train.steps import (
+            make_grad_accum_train_step,
+            make_train_step,
+        )
+
+        state = jax.eval_shape(
+            lambda: create_train_state(
+                model, tx, jax.random.key(0),
+                input_shape=(1, image_size, image_size, 3),
+            )
+        )
+        part = comp = None
+        shardings = None
+        if grad_compress:
+            from tpu_ddp.parallel.compression import (
+                GradCompression,
+                GradCompressor,
+            )
+
+            comp = GradCompressor(
+                GradCompression(**grad_compress), state.params,
+                mesh.shape[DATA_AXIS],
+            )
+        if zero1:
+            from tpu_ddp.parallel.zero import Zero1Partition
+
+            part = Zero1Partition(tx, state.params, mesh.shape[DATA_AXIS],
+                                  compress=comp)
+            state = state.replace(opt_state=part.opt_template)
+            shardings = part.state_shardings(state, mesh)
+        if comp is not None and comp.config.error_feedback:
+            state = state.replace(grad_residual=comp.residual_template())
+            if shardings is None:
+                rep = NamedSharding(mesh, P())
+                shardings = jax.tree.map(
+                    lambda _: rep, state.replace(grad_residual=None))
+            shardings = shardings.replace(
+                grad_residual=comp.residual_shardings(mesh))
+        if grad_accum_steps > 1:
+            step = make_grad_accum_train_step(
+                model, tx, mesh, accum_steps=grad_accum_steps,
+                loss_fn=loss_fn, remat=remat, zero1=part, compress=comp,
+                health=health)
+        else:
+            step = make_train_step(model, tx, mesh, loss_fn=loss_fn,
+                                   remat=remat, zero1=part, compress=comp,
+                                   health=health)
+        return step, abstract_train_state(state, shardings)
+
+    has_bs_state = jax.eval_shape(
+        lambda: create_train_state(
+            model, tx, jax.random.key(0),
+            input_shape=(1, image_size, image_size, 3),
+        )
+    )
+    state = has_bs_state
+    has_bs = bool(jax.tree.leaves(state.batch_stats))
+
+    if parallelism == "fsdp":
+        from tpu_ddp.parallel.tensor_parallel import make_fsdp_train_step
+
+        step, shardings = make_fsdp_train_step(
+            model, tx, mesh, state, loss_fn=loss_fn, has_batch_stats=has_bs,
+            remat=remat, grad_accum_steps=grad_accum_steps, health=health,
+        )
+        return step, abstract_train_state(state, shardings)
+
+    if parallelism in ("tp", "fsdp_tp"):
+        from tpu_ddp.parallel.tensor_parallel import (
+            make_fsdp_tp_train_step,
+            make_tp_train_step,
+        )
+
+        rules = _tp_rules_for(model, parallelism)
+        mk = (make_tp_train_step if parallelism == "tp"
+              else make_fsdp_tp_train_step)
+        step, shardings = mk(model, tx, mesh, state, rules=rules,
+                             loss_fn=loss_fn, has_batch_stats=has_bs,
+                             remat=remat, grad_accum_steps=grad_accum_steps,
+                             health=health)
+        return step, abstract_train_state(state, shardings)
+
+    if parallelism == "pp":
+        from tpu_ddp.models.vit import ViT
+        from tpu_ddp.parallel.pipeline import (
+            create_pp_train_state,
+            make_pp_train_step,
+        )
+
+        if not isinstance(model, ViT):
+            raise ValueError(
+                "--parallelism pp plans the GPipe ViT pipeline; pick a "
+                "vit_* model"
+            )
+        n_stages = mesh.shape[PIPELINE_AXIS]
+        if model.depth % n_stages:
+            raise ValueError(
+                f"pipeline stages ({n_stages}) must divide model depth "
+                f"{model.depth}"
+            )
+        pp_state = jax.eval_shape(
+            lambda: create_pp_train_state(
+                model, tx, jax.random.key(0),
+                input_shape=(1, image_size, image_size, 3),
+            )
+        )
+        step, shardings = make_pp_train_step(
+            model, tx, mesh, pp_state, n_microbatches=n_microbatches,
+            loss_fn=loss_fn, schedule=pp_schedule, health=health,
+        )
+        return step, abstract_train_state(pp_state, shardings)
+
+    if parallelism == "ep":
+        from tpu_ddp.models.moe import MoEViT
+        from tpu_ddp.parallel.expert_parallel import make_ep_train_step
+
+        if not isinstance(model, MoEViT):
+            raise ValueError(
+                "--parallelism ep plans the expert-parallel MoE layout; "
+                "pick vit_moe_s4"
+            )
+        step, shardings = make_ep_train_step(
+            model, tx, mesh, state, loss_fn=loss_fn,
+            remat=remat, grad_accum_steps=grad_accum_steps, health=health,
+        )
+        return step, abstract_train_state(state, shardings)
+
+    if parallelism == "sp":
+        from tpu_ddp.models.vit import ViT
+        from tpu_ddp.parallel.sequence_parallel import make_sp_train_step
+
+        if not isinstance(model, ViT):
+            raise ValueError(
+                "--parallelism sp plans the ring-attention ViT layout; "
+                "pick a vit_* model"
+            )
+        step = make_sp_train_step(
+            model.clone(sp_axis=SEQUENCE_AXIS, sp_flash=sp_flash), tx, mesh,
+            loss_fn=loss_fn, health=health,
+        )
+        return step, abstract_train_state(state)
+
+    raise ValueError(f"unknown parallelism {parallelism!r}")

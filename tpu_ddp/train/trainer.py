@@ -411,12 +411,28 @@ class Trainer:
 
         # Telemetry first: the loaders and checkpointer it is passed to are
         # built below. Disabled (NULL) unless --telemetry-dir is given.
-        from tpu_ddp.telemetry import build_telemetry
+        # The run-metadata header (config snapshot + jax version + device
+        # kind + mesh + strategy) lands as the first record of every file
+        # sink, so `tpu-ddp analyze`/`trace summarize` can label this run
+        # and refuse mismatched ones — run dirs used to be anonymous.
+        from tpu_ddp.telemetry import RUN_META_SCHEMA_VERSION, build_telemetry
 
+        self.run_meta = {
+            "run_meta_schema_version": RUN_META_SCHEMA_VERSION,
+            "config": dataclasses.asdict(config),
+            "jax_version": jax.__version__,
+            "device_kind": devices[0].device_kind,
+            "strategy": self.parallelism,
+            "mesh": dict(zip(self.mesh.axis_names,
+                             (int(s) for s in self.mesh.devices.shape))),
+            "n_devices": self.world_size,
+            "process_count": self.process_count,
+        }
         self.telemetry = build_telemetry(
             config.telemetry_dir,
             config.telemetry_sinks,
             process_index=self.process_index,
+            run_meta=self.run_meta,
         )
         self._watchdog = None
         # Numerics flight recorder (docs/health.md): the in-graph half is
