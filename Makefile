@@ -5,7 +5,7 @@ PYTHON ?= python
 
 .PHONY: test test-all dryrun bench smoke capture aot real-data lint \
 	trace-demo health-demo zero-demo compress-demo analyze-demo \
-	bench-compare
+	lint-demo bench-compare
 
 # Fast default loop (round-3 verdict item 5): skips the `slow`-marked
 # multi-process / end-to-end-CLI / AOT tests. CI and pre-commit should run
@@ -44,14 +44,19 @@ aot:
 real-data:
 	$(PYTHON) -m tpu_ddp.tools.real_data
 
-# Static checks (config in pyproject.toml [tool.ruff]). Skips with a
-# notice when ruff isn't installed (this build container doesn't ship it;
-# CI should).
+# Static checks (config in pyproject.toml [tool.ruff]; version pinned in
+# the dev extra). A REAL gate in CI: missing ruff fails there instead of
+# skipping. Locally (no $CI) it still skips with a notice when the
+# container doesn't ship ruff.
 lint:
 	@if $(PYTHON) -m ruff --version >/dev/null 2>&1; then \
 	  $(PYTHON) -m ruff check tpu_ddp tests; \
 	elif command -v ruff >/dev/null 2>&1; then \
 	  ruff check tpu_ddp tests; \
+	elif [ -n "$$CI" ]; then \
+	  echo "lint: ruff is required in CI (pip install the pinned version"; \
+	  echo "lint: from pyproject [project.optional-dependencies].lint)"; \
+	  exit 1; \
 	else \
 	  echo "lint: ruff not installed (pip install ruff); skipping"; \
 	fi
@@ -113,6 +118,18 @@ analyze-demo:
 	rm -rf $(ANALYZE_DEMO_DIR)
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
 	  $(PYTHON) -m tpu_ddp.tools.analyze_demo --dir $(ANALYZE_DEMO_DIR)
+
+# Graph-lint acceptance (docs/lint.md): `tpu-ddp lint --strategy all`
+# must pass clean on the 4-virtual-device CPU mesh (all nine strategy
+# programs + the RCP001 AST tier), two injected violations (stripped
+# donation, planted host callback) must exit nonzero with exactly their
+# rule ids (DON001 / XFR001), and a new finding count in the committed
+# lint artifact must fail `tpu-ddp bench compare`.
+LINT_DEMO_DIR ?= /tmp/tpu_ddp_lint_demo
+lint-demo:
+	rm -rf $(LINT_DEMO_DIR)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+	  $(PYTHON) -m tpu_ddp.tools.lint_demo --dir $(LINT_DEMO_DIR)
 
 # Deviceless perf-regression gate: re-capture the AOT artifact with the
 # real XLA:TPU toolchain (needs libtpu; ~30+ min of compiles) and diff

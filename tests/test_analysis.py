@@ -148,7 +148,9 @@ def test_anatomy_figures_populated(anatomies):
         assert a.bytes_accessed and a.bytes_accessed > 0, strategy
         assert a.argument_bytes and a.argument_bytes > 0, strategy
         assert a.fusion_count > 0, strategy
-        assert a.schema_version == 1
+        from tpu_ddp.analysis.hlo import ANATOMY_SCHEMA_VERSION
+
+        assert a.schema_version == ANATOMY_SCHEMA_VERSION
 
 
 def test_anatomy_json_round_trip(anatomies):
@@ -157,6 +159,11 @@ def test_anatomy_json_round_trip(anatomies):
     back = StepAnatomy.from_json(rec)
     assert back.flops == a.flops
     assert back.inventory() == a.inventory()
+    assert back.program_order == a.program_order
+    # a v1 record (pre-program_order) still loads, order defaults empty
+    v1 = {k: v for k, v in rec.items() if k != "program_order"}
+    assert StepAnatomy.from_json({**v1, "schema_version": 1}
+                                 ).program_order == []
     with pytest.raises(ValueError, match="newer"):
         StepAnatomy.from_json({**rec, "schema_version": 99})
 

@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 
 from tpu_ddp.checkpoint import Checkpointer, merge_params
-from tpu_ddp.data import synthetic_cifar10, synthetic_multilabel
+from tpu_ddp.data import synthetic_multilabel
 from tpu_ddp.models import NetResDeep
 from tpu_ddp.train import create_train_state, make_optimizer
 from tpu_ddp.train.kfold import kfold_split
@@ -43,8 +43,6 @@ def test_merge_params_head_swap():
 def test_freeze_mask_actually_freezes():
     """The reference's freeze loop is a silent no-op (required_grad typo,
     ppe_main_ddp.py:116-122). Ours must provably zero frozen updates."""
-    import optax
-
     from tpu_ddp.train.optim import freeze_all_but
 
     model = NetResDeep(n_blocks=1)
@@ -232,7 +230,6 @@ def test_preemption_checkpoints_and_resumes(tmp_path):
     import signal
     import subprocess
     import sys
-    import time
 
     env = dict(
         os.environ,

@@ -2,7 +2,6 @@
 (``ppe_main_ddp.py:28-37,91-93``), prediction visualization
 (``:355-396`` analogue), and in-epoch progress logging (``:151-152``)."""
 
-import os
 
 import numpy as np
 

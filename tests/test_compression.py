@@ -11,15 +11,13 @@ not associative). The lossy modes are pinned by their analytic error
 bounds and by trajectory closeness to the uncompressed run.
 """
 
-import dataclasses
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from tpu_ddp.data.cifar10 import synthetic_cifar10
 from tpu_ddp.models import NetResDeep

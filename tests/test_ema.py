@@ -8,7 +8,7 @@ import optax
 import pytest
 from jax.sharding import PartitionSpec as P
 
-from tpu_ddp.train.optim import EmaState, find_ema, make_optimizer, params_ema
+from tpu_ddp.train.optim import find_ema, make_optimizer, params_ema
 
 
 def test_params_ema_matches_manual_recursion():

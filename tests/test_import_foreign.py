@@ -11,7 +11,6 @@ import jax.numpy as jnp
 from tpu_ddp.checkpoint.import_foreign import (
     export_state_dict,
     import_state_dict,
-    load_state_dict,
 )
 from tpu_ddp.models.zoo import MODEL_REGISTRY
 

@@ -1,0 +1,394 @@
+"""Graph lint: per-rule positive/negative coverage + the gate wiring.
+
+The lint tier (``tpu_ddp/analysis/lint.py``) is the standing verifier
+every future layout/kernel PR lands behind, so these tests pin BOTH
+directions for every rule family: the clean pass across all nine
+strategy programs (a false positive would wedge CI), and an injected
+violation per rule that must trip exactly its rule id (a false negative
+would let the regression class the rule exists for — doubled HBM,
+halved wire bandwidth, multihost deadlock — back onto TPUs).
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_ddp.analysis.explain import (
+    STRATEGIES,
+    abstract_batch,
+    prepare_strategy_program,
+)
+from tpu_ddp.analysis.hlo import collective_schedule
+from tpu_ddp.analysis.lint import (
+    LintConfig,
+    RULES,
+    check_collective_order,
+    check_donation,
+    check_dtype_widening,
+    check_replication,
+    donation_report,
+    lint_program,
+    lint_source_text,
+    lint_source_tree,
+    lint_strategy,
+)
+from tpu_ddp.analysis.lint import main as lint_main
+from tpu_ddp.models import NetResDeep
+from tpu_ddp.parallel import MeshSpec, create_mesh
+from tpu_ddp.train import make_optimizer
+from tpu_ddp.train.losses import cross_entropy_loss
+from tpu_ddp.train.strategy import build_abstract_step
+
+CFG = LintConfig()
+
+
+@pytest.fixture(scope="module")
+def audits(devices):
+    """(findings, audit) per strategy, shared module-wide — the shared
+    compile cache makes these free after test_analysis."""
+    del devices
+    return {s: lint_strategy(s) for s in STRATEGIES}
+
+
+def _tiny_dp(loss_fn=cross_entropy_loss, dtype=jnp.float32, **kw):
+    mesh = create_mesh(MeshSpec(data=-1), jax.devices())
+    model = NetResDeep(n_chans1=8, n_blocks=2, num_classes=10, dtype=dtype)
+    tx = make_optimizer(lr=1e-1, momentum=0.9)
+    step, state = build_abstract_step("dp", model, tx, mesh,
+                                      loss_fn=loss_fn, **kw)
+    return step, state, mesh
+
+
+# -- the clean pass (negative direction for every program rule) -----------
+
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_strategy_programs_lint_clean(audits, strategy):
+    findings, audit = audits[strategy]
+    assert findings == [], (
+        f"{strategy}: {[f.message for f in findings]}"
+    )
+    assert audit.anatomy.program_order, "schedule extraction went empty"
+
+
+@pytest.mark.parametrize("strategy", ("dp", "zero1", "fsdp"))
+def test_bf16_programs_lint_clean(strategy):
+    """compute_dtype=bfloat16 arms DTY001: the real bf16 programs (f32
+    master weights, bf16 compute) must stay under the mixed-precision
+    allowlist budget."""
+    findings, _ = lint_strategy(strategy, compute_dtype="bfloat16")
+    assert findings == [], [f.message for f in findings]
+
+
+def test_source_tree_clean():
+    """RCP001 over the shipped tpu_ddp/ package — the repo-hygiene gate
+    (and the negative case for the AST rule)."""
+    findings = lint_source_tree()
+    assert findings == [], [f"{f.location}: {f.message}" for f in findings]
+
+
+# -- DON001: donation -----------------------------------------------------
+
+def test_don001_stripped_donation_trips(devices):
+    del devices
+    findings, _ = lint_strategy("dp", donate=False)
+    assert sorted({f.rule for f in findings}) == ["DON001"]
+    (f,) = [f for f in findings if f.rule == "DON001"]
+    assert "not (fully) donated" in f.message and f.fix
+
+
+def test_don001_accounting_matches_batch(audits):
+    """The oracle itself: for a donated step, argument_bytes − donated
+    bytes equals the batch's per-device bytes exactly (memplan's
+    accounting convention)."""
+    _, audit = audits["dp"]
+    rep = donation_report(audit.compiled, audit.batch, audit.mesh_shape)
+    assert rep["donated_bytes"] > 0
+    assert rep["non_donated_bytes"] == rep["expected_non_donated_bytes"]
+
+
+def test_abstract_twin_matches_live_donation(devices):
+    """Satellite pin: build_abstract_step mirrors the Trainer's real
+    donation settings — the abstract twin's compiled alias bytes equal
+    the live build_strategy program's, so lint verdicts apply to the
+    program that actually runs."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_ddp.models.vit import ViT
+    from tpu_ddp.train.strategy import build_strategy
+
+    mesh = create_mesh(MeshSpec(data=-1), devices)
+    model = ViT(patch_size=8, hidden_dim=32, depth=2, num_heads=2,
+                num_classes=10)
+    tx = make_optimizer(lr=1e-1, momentum=0.9)
+    step, state = build_abstract_step("fsdp", model, tx, mesh)
+    batch = abstract_batch(mesh, 8, 32)
+    abstract = step.trace(state, batch).lower().compile().memory_analysis()
+
+    live = build_strategy("fsdp", mesh, model, tx, jax.random.key(0))
+    gb = 8 * mesh.shape["data"]
+    concrete = {
+        "image": jnp.zeros((gb, 32, 32, 3)),
+        "label": jnp.zeros((gb,), jnp.int32),
+        "mask": jnp.ones((gb,), bool),
+    }
+    concrete = {k: jax.device_put(v, NamedSharding(mesh, P("data")))
+                for k, v in concrete.items()}
+    real = live.train_step.trace(
+        live.state, concrete).lower().compile().memory_analysis()
+    assert abstract.alias_size_in_bytes == real.alias_size_in_bytes > 0
+    assert abstract.argument_size_in_bytes == real.argument_size_in_bytes
+
+
+# -- DTY001: dtype widening ----------------------------------------------
+
+def test_dty001_forced_f32_psum_payload_trips():
+    def psum_loss(logits, labels, mask=None):
+        big = lax.psum(jnp.zeros((1 << 20,), jnp.float32), "data")
+        return cross_entropy_loss(logits, labels, mask) + big.sum() * 1e-30
+
+    step, state, mesh = _tiny_dp(loss_fn=psum_loss, dtype=jnp.bfloat16)
+    findings, _ = lint_program(step, state, abstract_batch(mesh, 8, 32),
+                               mesh, compute_dtype="bfloat16")
+    assert sorted({f.rule for f in findings}) == ["DTY001"]
+    assert "allowlist budget" in findings[0].message
+
+
+def test_dty001_big_f32_op_trips():
+    """An f32 model compiled into a program CLAIMING bf16 compute — the
+    accidental-upcast shape — trips on its big f32 convolutions."""
+    step, state, mesh = _tiny_dp(dtype=jnp.float32)
+    findings, audit = lint_program(
+        step, state, abstract_batch(mesh, 64, 32), mesh,
+        compute_dtype="bfloat16")
+    dty = [f for f in findings if f.rule == "DTY001"]
+    assert dty and any("f32 tensor op" in f.message for f in dty)
+
+
+def test_dty001_disarmed_for_f32_programs(audits):
+    _, audit = audits["dp"]
+    assert check_dtype_widening(audit, CFG) == []
+
+
+# -- SHD001: physical replication ----------------------------------------
+
+def test_shd001_desharded_zero1_opt_state_trips(audits):
+    """The realistic regression: a zero1 builder that silently stopped
+    scattering compiles the dp (replicated-state) program. Relabeling
+    the dp audit as zero1 IS that program; the rule must refuse it."""
+    _, dp_audit = audits["dp"]
+    bad = dataclasses.replace(dp_audit, strategy="zero1", program="zero1")
+    findings = check_replication(bad, CFG)
+    assert [f.rule for f in findings] == ["SHD001"]
+    assert "opt_state" in findings[0].message
+
+
+def test_shd001_sharded_layouts_pass(audits):
+    for strategy in ("zero1", "fsdp", "fsdp_tp", "ep"):
+        _, audit = audits[strategy]
+        assert check_replication(audit, CFG) == [], strategy
+
+
+# -- COL001: collective order / participation ----------------------------
+
+def test_col001_reordered_schedule_trips(audits):
+    _, audit = audits["zero1"]
+    sched = collective_schedule(audit.hlo_text, audit.mesh_shape)
+    reordered = sorted(sched,
+                       key=lambda e: 0 if e.kind == "all-gather" else 1)
+    reordered = [dataclasses.replace(e, index=i)
+                 for i, e in enumerate(reordered)]
+    findings = check_collective_order(audit, CFG, schedule=reordered)
+    assert [f.rule for f in findings] == ["COL001"]
+    assert "reordered" in findings[0].message
+
+
+def test_col001_partial_group_trips(audits):
+    _, audit = audits["zero1"]
+    sched = collective_schedule(audit.hlo_text, audit.mesh_shape)
+    poisoned = [dataclasses.replace(e, groups=[(0, 1, 2)])
+                if e.groups else e for e in sched[:1]]
+    findings = check_collective_order(audit, CFG, schedule=poisoned)
+    assert any("do not partition" in f.message for f in findings)
+
+
+def test_col001_non_permutation_pairs_trip(audits):
+    _, audit = audits["sp"]
+    sched = collective_schedule(audit.hlo_text, audit.mesh_shape)
+    perm = next(e for e in sched if e.pairs)
+    dup = dataclasses.replace(perm, pairs=[(0, 1), (0, 2)])
+    findings = check_collective_order(audit, CFG, schedule=[dup])
+    assert any("not a permutation" in f.message for f in findings)
+
+
+def test_col001_missing_fingerprint_kind_trips(audits):
+    """A dp (all-reduce only) program labeled zero1 lacks the required
+    all-gather family — the pinned-fingerprint half of COL001."""
+    _, dp_audit = audits["dp"]
+    bad = dataclasses.replace(dp_audit, strategy="zero1", program="zero1")
+    findings = check_collective_order(bad, CFG)
+    assert any(f.rule == "COL001" and "missing" in f.message
+               for f in findings)
+
+
+# -- XFR001: host transfers ----------------------------------------------
+
+def test_xfr001_planted_callback_trips_exactly():
+    def chatty_loss(logits, labels, mask=None):
+        jax.debug.print("x={x}", x=logits.sum())
+        return cross_entropy_loss(logits, labels, mask)
+
+    step, state, mesh = _tiny_dp(loss_fn=chatty_loss)
+    findings, _ = lint_program(step, state, abstract_batch(mesh, 8, 32),
+                               mesh)
+    assert sorted({f.rule for f in findings}) == ["XFR001"]
+
+
+# -- RCP001: AST tier -----------------------------------------------------
+
+def test_rcp001_jit_in_loop_trips():
+    src = "import jax\nfor i in range(3):\n    f = jax.jit(lambda x: x)\n"
+    findings = lint_source_text(src, "bad.py")
+    assert [f.rule for f in findings] == ["RCP001"]
+    assert "loop" in findings[0].message and "bad.py:3" in findings[0].location
+
+
+def test_rcp001_mutable_default_on_jitted_fn_trips():
+    src = ("import jax, functools\n"
+           "@functools.partial(jax.jit, static_argnames=('cfg',))\n"
+           "def step(x, cfg={}):\n    return x\n")
+    findings = lint_source_text(src, "bad.py")
+    assert [f.rule for f in findings] == ["RCP001"]
+    assert "mutable" in findings[0].message
+
+
+def test_rcp001_wallclock_in_factory_trips():
+    src = ("import time\nimport jax\n"
+           "def make_train_step(model):\n"
+           "    def step(s, b):\n        return s, time.time()\n"
+           "    return jax.jit(step)\n")
+    findings = lint_source_text(src, "bad.py")
+    assert [f.rule for f in findings] == ["RCP001"]
+    assert "time.time" in findings[0].message
+
+
+def test_rcp001_negatives():
+    # the factory idiom (jit built once per factory call) is NOT a hazard
+    ok = ("import jax\n"
+          "def make_step(f):\n    return jax.jit(f)\n"
+          "steps = [make_step(str) for _ in range(3)]\n")
+    assert lint_source_text(ok, "ok.py") == []
+    # jax.random is keyed and deterministic — not stdlib random, even
+    # when imported as `from jax import random`
+    ok2 = ("from jax import random\n"
+           "def make_init(shape):\n"
+           "    def init(key):\n"
+           "        return random.uniform(key, shape)\n"
+           "    return init\n")
+    assert lint_source_text(ok2, "ok2.py") == []
+
+
+# -- the CLI + artifact + compare gate ------------------------------------
+
+def test_cli_clean_exit_and_artifact(tmp_path, capsys):
+    out = tmp_path / "lint.json"
+    rc = lint_main(["--strategy", "dp", "--json", str(out)])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out
+    art = json.loads(out.read_text())
+    assert set(art["programs"]) == {"dp", "source"}
+    rec = art["programs"]["dp"]
+    assert rec["rule_counts"] == {} and rec["findings"] == []
+    assert rec["program_order"] and rec["inventory"]
+
+
+def test_cli_unknown_strategy_exits_2(capsys):
+    assert lint_main(["--strategy", "nope", "--no-source"]) == 2
+    assert "unknown strategy" in capsys.readouterr().out
+
+
+def test_new_lint_finding_gates_in_bench_compare(tmp_path):
+    from tpu_ddp.analysis.regress import compare, load_artifact
+
+    out = tmp_path / "lint.json"
+    assert lint_main(["--strategy", "dp", "--json", str(out),
+                      "--no-source"]) == 0
+    base = load_artifact(str(out))
+    poisoned = json.loads(json.dumps(base))
+    poisoned["dp"]["rule_counts"] = {"XFR001": 1}
+    result = compare(base, poisoned)
+    assert any("lint/XFR001" in r for r in result["regressions"])
+    # and the reverse direction reads as an improvement, not a failure
+    result = compare(poisoned, base)
+    assert not result["regressions"]
+    assert any("lint/XFR001" in i for i in result["improvements"])
+
+
+def test_program_reorder_gates_in_bench_compare():
+    from tpu_ddp.analysis.regress import compare
+
+    base = {"dp": {"program_order": ["all-reduce/f32/data/g8",
+                                     "all-gather/f32/data/g8"]}}
+    moved = {"dp": {"program_order": ["all-gather/f32/data/g8",
+                                      "all-reduce/f32/data/g8"]}}
+    result = compare(base, moved)
+    assert any("reordered" in r for r in result["regressions"])
+    assert not compare(base, json.loads(json.dumps(base)))["regressions"]
+
+
+def test_rules_registry_documented():
+    for rule, meta in RULES.items():
+        assert meta["title"] and meta["fix"], rule
+
+
+# -- Trainer preflight ----------------------------------------------------
+
+def _trainer_config(**kw):
+    from tpu_ddp.train.trainer import TrainConfig
+
+    return TrainConfig(
+        synthetic_data=True, synthetic_size=256, epochs=1,
+        per_shard_batch=8, model="netresdeep", n_chans1=8, n_blocks=2,
+        prefetch_depth=0, log_every_epochs=1, **kw,
+    )
+
+
+def test_trainer_preflight_clean(devices):
+    del devices
+    from tpu_ddp.train.trainer import Trainer
+
+    trainer = Trainer(_trainer_config(lint_on_start=True))
+    try:
+        findings = trainer.lint_preflight()
+        assert findings == []
+    finally:
+        trainer.close()
+
+
+def test_trainer_preflight_refuses_violating_program(devices):
+    del devices
+    from tpu_ddp.train.steps import make_train_step
+    from tpu_ddp.train.trainer import Trainer
+
+    trainer = Trainer(_trainer_config())
+    try:
+        # regress the step to a donation-less build: the preflight must
+        # refuse the launch with the rule id in view
+        trainer.train_step = make_train_step(
+            trainer.model, trainer.tx, trainer.mesh, donate=False)
+        with pytest.raises(RuntimeError, match="lint preflight"):
+            trainer.lint_preflight()
+    finally:
+        trainer.close()
+
+
+def test_trainer_runs_with_lint_on_start(devices):
+    del devices
+    from tpu_ddp.train.trainer import Trainer
+
+    result = Trainer(_trainer_config(lint_on_start=True)).run()
+    assert result["total_seconds"] > 0

@@ -55,8 +55,6 @@ def test_gather_rows_large_uses_native_and_matches():
 
 
 def _roundtrip_prefetcher(ring_cls):
-    from tpu_ddp.native import prefetch as pf_mod
-
     rng = np.random.default_rng(4)
     images = rng.normal(size=(40, 8, 8, 3)).astype(np.float32)
     labels = rng.integers(0, 10, size=40).astype(np.int64)

@@ -6,7 +6,6 @@ the 93% flow with zero decisions (round-4 verdict item 7)."""
 import hashlib
 import json
 import os
-import sys
 
 import pytest
 

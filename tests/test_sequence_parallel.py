@@ -3,9 +3,8 @@ mesh: SP loss must equal the non-SP loss on identical params/data, and a
 training step must run and reduce loss."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from tpu_ddp.data import synthetic_cifar10
 from tpu_ddp.models.vit import ViT

@@ -10,7 +10,6 @@ but that is not guaranteed by the spec.
 """
 
 import dataclasses
-import os
 
 import jax
 import jax.numpy as jnp
@@ -513,7 +512,7 @@ def test_zero1_sharded_clip_matches_optax(devices):
     """clip_by_global_norm_sharded on scattered shards == optax's clip on
     the full tree (both trigger and no-trigger regimes)."""
     from jax import lax
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     mesh = create_mesh(MeshSpec(data=4), devices[:4])
     full = {"a": jnp.arange(10, dtype=jnp.float32) / 10.0,

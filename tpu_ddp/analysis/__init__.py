@@ -7,19 +7,31 @@ table and attributes an anatomy into compute/HBM/ICI time terms with a
 bound classification; ``analysis/explain.py`` is ``tpu-ddp analyze``
 (static report + measured-telemetry join + per-strategy collective
 fingerprints); ``analysis/regress.py`` is ``tpu-ddp bench compare`` (the
-deviceless CI perf-regression gate). See docs/analysis.md.
+deviceless CI perf-regression gate); ``analysis/lint.py`` is
+``tpu-ddp lint`` (the static sharding/donation/numerics verifier every
+compiled step gates through — docs/lint.md). See docs/analysis.md.
 """
 
 from tpu_ddp.analysis.hlo import (
     ANATOMY_SCHEMA_VERSION,
     Collective,
+    ScheduledCollective,
     StepAnatomy,
     cached_compile,
     clear_compile_cache,
+    collective_schedule,
     compile_cache_stats,
     extract_anatomy,
     extract_collectives,
     hlo_op_counts,
+)
+from tpu_ddp.analysis.lint import (
+    LintConfig,
+    LintFinding,
+    RULES as LINT_RULES,
+    lint_program,
+    lint_source_tree,
+    lint_strategy,
 )
 from tpu_ddp.analysis.roofline import (
     CHIP_SPECS,
@@ -41,6 +53,14 @@ __all__ = [
     "extract_anatomy",
     "extract_collectives",
     "hlo_op_counts",
+    "ScheduledCollective",
+    "collective_schedule",
+    "LintConfig",
+    "LintFinding",
+    "LINT_RULES",
+    "lint_program",
+    "lint_source_tree",
+    "lint_strategy",
     "CHIP_SPECS",
     "ChipSpec",
     "RooflineReport",

@@ -23,6 +23,7 @@ devicelessly, before any TPU run (``make analyze-demo`` gates CI on it).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 from typing import Any, Dict, Optional, Sequence
 
@@ -165,7 +166,54 @@ def _zoo_model(model_name: str, num_classes: int, image_size: int, dtype):
     return MODEL_REGISTRY[model_name](num_classes=num_classes, dtype=dtype)
 
 
-def anatomy_for_strategy(
+@dataclasses.dataclass
+class StrategyProgram:
+    """Everything one strategy's compile-ready abstract program consists
+    of — the shared product of :func:`prepare_strategy_program`, consumed
+    by :func:`anatomy_for_strategy` (extraction) and
+    ``analysis/lint.py`` (static verification), so both reason about the
+    SAME program under the same compile-cache key."""
+
+    strategy: str
+    parallelism: str
+    step: Any
+    state: Any
+    batch: Dict[str, Any]
+    mesh: Any
+    model_name: str
+    compute_dtype: str
+    per_shard_batch: int
+    image_size: int
+    cache_key: tuple
+
+    def compile(self):
+        """The cached compiled executable for this program."""
+        return cached_compile(
+            self.cache_key,
+            lambda: self.step.trace(self.state, self.batch)
+            .lower().compile(),
+        )
+
+
+def abstract_batch(mesh, per_shard_batch: int, image_size: int) -> dict:
+    """The abstract CIFAR-shaped global batch every anatomy/lint compile
+    uses: batch scales with the data axis only, sharded on axis 0."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_ddp.parallel import batch_sharding
+
+    gb = per_shard_batch * mesh.shape["data"]
+    bs = batch_sharding(mesh)
+    return {
+        "image": jax.ShapeDtypeStruct((gb, image_size, image_size, 3),
+                                      jnp.float32, sharding=bs),
+        "label": jax.ShapeDtypeStruct((gb,), jnp.int32, sharding=bs),
+        "mask": jax.ShapeDtypeStruct((gb,), bool, sharding=bs),
+    }
+
+
+def prepare_strategy_program(
     strategy: str,
     *,
     devices=None,
@@ -181,11 +229,14 @@ def anatomy_for_strategy(
     compress_mode: str = "int8",
     compress_block: int = 256,
     n_microbatches: int = 2,
-) -> StepAnatomy:
-    """Compile the strategy's real train step (abstractly, via the shared
-    builder + compile cache) and extract its anatomy. ``devices`` default
-    to the current backend's; pass deviceless topology devices for
-    TPU-target analysis on a CPU host."""
+    donate: bool = True,
+) -> StrategyProgram:
+    """Build the strategy's real abstract train step + inputs (via the
+    shared ``build_abstract_step``) without compiling. ``devices``
+    default to the current backend's; pass deviceless topology devices
+    for TPU-target analysis on a CPU host. ``donate=False`` exists for
+    the lint tier's injected-violation path only — the product always
+    donates the state."""
     import jax
     import jax.numpy as jnp
 
@@ -237,6 +288,7 @@ def anatomy_for_strategy(
         parallelism, model, tx, mesh, image_size=image_size, remat=remat,
         grad_accum_steps=grad_accum_steps, zero1=zero1,
         grad_compress=grad_compress, n_microbatches=n_microbatches,
+        donate=donate,
     )
     key = (
         # an explicitly passed model object has no zoo name: key on its
@@ -248,12 +300,26 @@ def anatomy_for_strategy(
         devices[0].device_kind, len(devices),
         compress_mode if grad_compress else None,
         compress_block if grad_compress else None, n_microbatches,
+        donate,
     )
-    return _compile_anatomy(
-        step, state, mesh, cache_key=key, strategy=strategy,
-        model_name=model_name or "custom",
-        per_shard_batch=per_shard_batch, image_size=image_size,
-        compute_dtype=compute_dtype,
+    return StrategyProgram(
+        strategy=strategy, parallelism=parallelism, step=step, state=state,
+        batch=abstract_batch(mesh, per_shard_batch, image_size),
+        mesh=mesh, model_name=model_name or "custom",
+        compute_dtype=compute_dtype, per_shard_batch=per_shard_batch,
+        image_size=image_size, cache_key=key,
+    )
+
+
+def anatomy_for_strategy(strategy: str, **kwargs) -> StepAnatomy:
+    """Compile the strategy's real train step (abstractly, via the shared
+    builder + compile cache) and extract its anatomy. Accepts every
+    :func:`prepare_strategy_program` keyword."""
+    prog = prepare_strategy_program(strategy, **kwargs)
+    return extract_anatomy(
+        prog.compile(), strategy=prog.strategy, model=prog.model_name,
+        mesh=prog.mesh, per_shard_batch=prog.per_shard_batch,
+        compute_dtype=prog.compute_dtype,
     )
 
 
@@ -261,19 +327,7 @@ def _compile_anatomy(step, state, mesh, *, cache_key, strategy, model_name,
                      per_shard_batch, image_size, compute_dtype):
     """Shared tail of every anatomy builder: abstract batch -> cached
     compile -> extraction."""
-    import jax
-    import jax.numpy as jnp
-
-    from tpu_ddp.parallel import batch_sharding
-
-    gb = per_shard_batch * mesh.shape["data"]
-    bs = batch_sharding(mesh)
-    batch = {
-        "image": jax.ShapeDtypeStruct((gb, image_size, image_size, 3),
-                                      jnp.float32, sharding=bs),
-        "label": jax.ShapeDtypeStruct((gb,), jnp.int32, sharding=bs),
-        "mask": jax.ShapeDtypeStruct((gb,), bool, sharding=bs),
-    }
+    batch = abstract_batch(mesh, per_shard_batch, image_size)
     compiled = cached_compile(
         cache_key, lambda: step.trace(state, batch).lower().compile()
     )

@@ -33,7 +33,8 @@ import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 #: bump on any breaking change to the StepAnatomy record shape
-ANATOMY_SCHEMA_VERSION = 1
+#: (v2: + ``program_order`` — the linearized collective schedule)
+ANATOMY_SCHEMA_VERSION = 2
 
 #: collective opcodes the inventory tracks (definition sites, sync or
 #: async ``-start`` — ``-done`` halves are the same transfer)
@@ -235,6 +236,81 @@ def _wire_bytes(kind: str, payload: int, g: int) -> int:
     return int((g - 1) / g * payload)
 
 
+@dataclasses.dataclass
+class ScheduledCollective:
+    """ONE collective instruction in optimized-HLO text order — the unit
+    the lint tier's COL001 (collective order / participation symmetry)
+    reasons over, where :class:`Collective` is the aggregated bucket the
+    inventory diff reasons over. ``dtype`` is the dominant (largest-
+    payload) operand dtype; ``payload_bytes`` sums every operand dtype
+    (all-gather scaled by group size — the operand is one shard).
+    ``groups``/``pairs`` are the raw participation sets, kept so callers
+    can verify every device takes part exactly once."""
+
+    index: int
+    kind: str
+    dtype: str
+    axis: str
+    group_size: int
+    payload_bytes: int
+    groups: Optional[List[Tuple[int, ...]]]
+    pairs: Optional[List[Tuple[int, int]]]
+
+    def key(self) -> str:
+        return f"{self.kind}/{self.dtype}/{self.axis}/g{self.group_size}"
+
+
+def _parse_collective_line(line: str, mesh_shape):
+    """(kind, per-dtype payload bytes, groups, pairs, group size, axis)
+    for one HLO collective definition line, or None. The shared parse
+    behind the aggregated inventory AND the ordered schedule."""
+    m = _OP_RE.search(line)
+    if m is None:
+        return None
+    kind = m.group("op")
+    operands = _operand_segment(line, line.index("(", m.end() - 1))
+    rest = line[m.end():]
+    groups = _parse_groups(rest)
+    pairs = _parse_pairs(rest)
+    if kind == "collective-permute":
+        g = len(pairs) if pairs else 0
+        axis = _axis_of_pairs(pairs, mesh_shape) if pairs else "unknown"
+    else:
+        g = len(groups[0]) if groups else 0
+        axis = (_axis_of_groups(groups, mesh_shape) if groups
+                else "unknown")
+    per_dtype = _array_bytes(operands)
+    if kind == "all-gather" and g > 1:
+        per_dtype = {d: n * g for d, n in per_dtype.items()}
+    return kind, per_dtype, groups, pairs, g, axis
+
+
+def collective_schedule(
+    hlo_text: str, mesh_shape: Optional[Dict[str, int]] = None,
+) -> List[ScheduledCollective]:
+    """The linearized collective schedule: one entry per collective
+    definition site, in optimized-HLO text order (topological within each
+    computation — deterministic for a given compile, which is what the
+    order pin needs; entries inside scan/while bodies appear where their
+    computation is printed)."""
+    out: List[ScheduledCollective] = []
+    for line in hlo_text.splitlines():
+        parsed = _parse_collective_line(line, mesh_shape)
+        if parsed is None:
+            continue
+        kind, per_dtype, groups, pairs, g, axis = parsed
+        if per_dtype:
+            dtype = max(per_dtype, key=per_dtype.get)
+        else:
+            dtype = "unknown"
+        out.append(ScheduledCollective(
+            index=len(out), kind=kind, dtype=dtype, axis=axis,
+            group_size=g, payload_bytes=sum(per_dtype.values()),
+            groups=groups, pairs=pairs,
+        ))
+    return out
+
+
 def extract_collectives(
     hlo_text: str, mesh_shape: Optional[Dict[str, int]] = None,
 ) -> List[Collective]:
@@ -242,25 +318,11 @@ def extract_collectives(
     aggregated inventory, sorted by descending wire bytes."""
     buckets: Dict[Tuple[str, str, str, int], Dict[str, int]] = {}
     for line in hlo_text.splitlines():
-        m = _OP_RE.search(line)
-        if m is None:
+        parsed = _parse_collective_line(line, mesh_shape)
+        if parsed is None:
             continue
-        kind = m.group("op")
-        operands = _operand_segment(line, line.index("(", m.end() - 1))
-        rest = line[m.end():]
-        groups = _parse_groups(rest)
-        pairs = _parse_pairs(rest)
-        if kind == "collective-permute":
-            g = len(pairs) if pairs else 0
-            axis = _axis_of_pairs(pairs, mesh_shape) if pairs else "unknown"
-        else:
-            g = len(groups[0]) if groups else 0
-            axis = (_axis_of_groups(groups, mesh_shape) if groups
-                    else "unknown")
-        per_dtype = _array_bytes(operands)
+        kind, per_dtype, _groups, _pairs, g, axis = parsed
         for dtype, nbytes in per_dtype.items():
-            if kind == "all-gather" and g > 1:
-                nbytes *= g  # operand is the per-device shard
             b = buckets.setdefault((kind, dtype, axis, g),
                                    {"count": 0, "payload": 0, "wire": 0})
             b["count"] += 1
@@ -316,6 +378,10 @@ class StepAnatomy:
     fusion_count: int
     hlo_ops: Dict[str, int]
     collectives: List[Collective]
+    #: inventory keys in optimized-HLO program order (one entry per
+    #: collective instruction, dominant dtype) — the schedule COL001 and
+    #: the compare gate's reorder check pin; [] on pre-v2 records
+    program_order: List[str] = dataclasses.field(default_factory=list)
     schema_version: int = ANATOMY_SCHEMA_VERSION
 
     @property
@@ -442,6 +508,8 @@ def extract_anatomy(
         fusion_count=ops.get("fusion", 0),
         hlo_ops=ops,
         collectives=extract_collectives(text, mesh_shape),
+        program_order=[c.key()
+                       for c in collective_schedule(text, mesh_shape)],
     )
 
 

@@ -87,8 +87,11 @@ def _inventory(rec: dict) -> Optional[Dict[str, dict]]:
 
 def _counts(rec: dict) -> Dict[str, int]:
     """All exact-compare counters of a record: explicit count keys, the
-    COLLECTIVE rows of the ``hlo_ops`` opcode table, and
-    per-(kind/dtype/axis/gN) inventory counts."""
+    COLLECTIVE rows of the ``hlo_ops`` opcode table, per-
+    (kind/dtype/axis/gN) inventory counts, and — for ``tpu-ddp lint
+    --json`` artifacts — per-rule lint finding counts (a NEW lint
+    finding in a committed artifact gates exactly like an extra
+    collective; a fixed one reads as an improvement)."""
     out: Dict[str, int] = {}
     for key in _COUNT_KEYS:
         if isinstance(rec.get(key), (int, float)):
@@ -99,6 +102,9 @@ def _counts(rec: dict) -> Dict[str, int]:
     for key, entry in (_inventory(rec) or {}).items():
         if isinstance(entry, dict) and "count" in entry:
             out[f"inventory/{key}"] = int(entry["count"])
+    for rule, n in (rec.get("rule_counts") or {}).items():
+        if isinstance(n, (int, float)):
+            out[f"lint/{rule}"] = int(n)
     return out
 
 
@@ -208,6 +214,19 @@ def compare(old: Dict[str, dict], new: Dict[str, dict],
                 )
             elif ov > nv * (1 + tolerance) and ov > nv + 2:
                 improvements.append(f"{name}: {key}: {ov} -> {nv}")
+        # program-order (anatomy schema v2 / lint artifacts): when the
+        # collective MULTISET is unchanged but the linearized schedule
+        # moved, that is a layout/overlap change the counts can't see —
+        # a reordered schedule across builders is the multihost-deadlock
+        # class COL001 guards, so it gates. (Different multisets are
+        # already fully gated by the count rules above.)
+        oo, no_ = o.get("program_order"), n.get("program_order")
+        if (isinstance(oo, list) and isinstance(no_, list) and oo and no_
+                and oo != no_ and sorted(oo) == sorted(no_)):
+            regressions.append(
+                f"{name}: collective schedule reordered (same inventory, "
+                f"different program order: {len(oo)} collectives)"
+            )
         osz, nsz = _sizes(o), _sizes(n)
         for key in sorted(set(osz) | set(nsz)):
             ov, nv = osz.get(key), nsz.get(key)

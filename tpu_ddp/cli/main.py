@@ -15,9 +15,17 @@ Subcommands:
   classification, per-strategy collective fingerprint; given a run dir,
   joins the measured telemetry (achieved-vs-roofline, MFU, data-wait
   share). Compiles the real step, so it needs jax (docs/analysis.md).
+- ``tpu-ddp lint [--strategy all]`` — static verifier over every
+  strategy's compiled step: donation accounting (DON001), dtype
+  widening (DTY001), physical sharding (SHD001), collective order /
+  participation (COL001), host transfers (XFR001), plus the RCP001
+  recompile-hazard AST tier over ``tpu_ddp/`` source. Exits 1 on any
+  finding; ``--json`` output gates through ``bench compare``
+  (docs/lint.md).
 - ``tpu-ddp bench compare old.json new.json`` — structured diff of two
-  bench/AOT/analyze artifacts; exits 1 on regressions (extra
-  collectives, widened payload dtypes, memory/flops growth).
+  bench/AOT/analyze/lint artifacts; exits 1 on regressions (extra
+  collectives, widened payload dtypes, memory/flops growth, new lint
+  findings).
 
 ``trace summarize``, ``health``, and ``bench compare`` are stdlib-only
 end to end (no jax import): records are summarized wherever they land —
@@ -73,6 +81,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_ddp.analysis.explain import main as analyze_main
 
         return analyze_main(argv[1:])
+    if argv[:1] == ["lint"]:
+        from tpu_ddp.analysis.lint import main as lint_main
+
+        return lint_main(argv[1:])
     if argv[:2] == ["bench", "compare"]:
         from tpu_ddp.analysis.regress import main as compare_main
 
@@ -108,6 +120,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="static step anatomy + roofline + collective fingerprint, "
              "optionally joined with a run dir's telemetry "
              "(tpu-ddp analyze --help)",
+    )
+    sub.add_parser(
+        "lint",
+        help="static sharding/donation/numerics verifier over every "
+             "strategy's compiled step (tpu-ddp lint --help)",
     )
     bench = sub.add_parser("bench", help="bench artifact tools")
     bench_sub = bench.add_subparsers(dest="bench_command", required=True)

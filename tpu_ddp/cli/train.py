@@ -237,6 +237,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--health-spike-threshold", type=float, default=10.0,
                    metavar="K",
                    help="spike when loss > median + K * MAD of the window")
+    p.add_argument("--lint-on-start", action="store_true",
+                   help="preflight: run the static graph lint (donation / "
+                        "dtype / sharding / collective-order / host-"
+                        "transfer rules, docs/lint.md) over the compiled "
+                        "step and refuse to launch on a finding")
     p.add_argument("--compilation-cache-dir", default=None, metavar="DIR",
                    help="persistent XLA compilation cache: repeat runs skip "
                         "the 20-40s first-compile (cache is keyed on "
@@ -404,6 +409,7 @@ def config_from_args(args) -> TrainConfig:
         health_dir=args.health_dir,
         health_window=args.health_window,
         health_spike_threshold=args.health_spike_threshold,
+        lint_on_start=args.lint_on_start,
         freeze_prefixes=tuple(args.freeze) if args.freeze else None,
         loss=args.loss,
         label_smoothing=args.label_smoothing,

@@ -13,7 +13,6 @@ neither possible nor a goal).
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import nn as jnn
 from jax import random
 from jax.nn.initializers import variance_scaling
 

@@ -20,7 +20,7 @@ from __future__ import annotations
 import ctypes
 import queue
 import threading
-from typing import Iterator, Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 

@@ -38,7 +38,6 @@ them exactly as it does uncompressed.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
 
 import jax
 

@@ -21,7 +21,6 @@ from typing import Callable, Optional
 import jax
 
 import tpu_ddp.compat  # noqa: F401  (jax.shard_map/typeof shims)
-import jax.numpy as jnp
 import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P

@@ -35,7 +35,6 @@ from tpu_ddp.parallel.partitioning import (
     compose_fsdp_over,
     fsdp_specs,
     specs_for_params,
-    shard_train_state,
     train_state_shardings,
 )
 from tpu_ddp.train.losses import cross_entropy_loss

@@ -50,7 +50,7 @@ convention as ``GRAD_SYNC_IN_AD`` (tpu_ddp.compat).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 

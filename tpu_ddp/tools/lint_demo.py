@@ -1,0 +1,134 @@
+"""``make lint-demo`` — end-to-end proof of the graph lint gate.
+
+Runs on the virtual CPU mesh (no TPU), in three acts:
+
+1. ``tpu-ddp lint --strategy all --json`` must exit 0: all nine strategy
+   programs (incl. the zero1 / grad-compress layout overlays) and the
+   RCP001 AST tier come back clean;
+2. two injected violations must exit nonzero with the RIGHT rule ids:
+   a step compiled with its donation stripped must trip **DON001**, and
+   a step with a planted host callback in its loss must trip **XFR001**
+   (proving the gate detects, not just describes);
+3. the lint artifact must gate through ``tpu-ddp bench compare``: a
+   clean self-compare passes, and a copy with one new finding count
+   fails — a newly-introduced lint finding in a committed artifact
+   regresses exactly like an extra collective.
+
+Exits non-zero if any outcome is missing, so CI runs it as a living
+acceptance test (alongside ``analyze-demo``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="graph lint demo")
+    ap.add_argument("--dir", required=True, help="artifact dir")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tpu_ddp.analysis.explain import abstract_batch
+    from tpu_ddp.analysis.lint import lint_program, lint_strategy
+    from tpu_ddp.analysis.lint import main as lint_main
+    from tpu_ddp.analysis.regress import main as compare_main
+
+    os.makedirs(args.dir, exist_ok=True)
+    n_dev = len(jax.devices())
+    ok = True
+
+    # -- 1. the full lint must pass clean ---------------------------------
+    artifact = os.path.join(args.dir, "lint.json")
+    print(f"[lint-demo] tpu-ddp lint --strategy all on {n_dev} CPU "
+          "devices", flush=True)
+    rc = lint_main(["--strategy", "all", "--json", artifact])
+    if rc != 0:
+        print(f"[lint-demo] FAIL: tpu-ddp lint exited {rc} on the clean "
+              "tree", file=sys.stderr)
+        ok = False
+
+    # -- 2. injected violations must trip their rules ---------------------
+    # (a) stripped donation: the same dp program compiled without
+    # donate_argnums must trip DON001 — the missing alias doubles the
+    # state's HBM footprint
+    findings, _ = lint_strategy("dp", donate=False)
+    rules = sorted({f.rule for f in findings})
+    if "DON001" not in rules:
+        print(f"[lint-demo] FAIL: stripped donation tripped {rules}, "
+              "not DON001", file=sys.stderr)
+        ok = False
+    else:
+        print(f"[lint-demo] injected donation strip -> {rules} OK",
+              flush=True)
+
+    # (b) planted host callback: a debug print inside the loss is a
+    # device->host round trip per step — XFR001
+    from tpu_ddp.models import NetResDeep
+    from tpu_ddp.parallel import MeshSpec, create_mesh
+    from tpu_ddp.train import make_optimizer
+    from tpu_ddp.train.losses import cross_entropy_loss
+    from tpu_ddp.train.strategy import build_abstract_step
+
+    def chatty_loss(logits, labels, mask=None):
+        jax.debug.print("loss={x}", x=logits.sum())
+        return cross_entropy_loss(logits, labels, mask)
+
+    mesh = create_mesh(MeshSpec(data=-1), jax.devices())
+    model = NetResDeep(n_chans1=8, n_blocks=2, num_classes=10)
+    tx = make_optimizer(lr=1e-1, momentum=0.9)
+    step, state = build_abstract_step("dp", model, tx, mesh,
+                                      loss_fn=chatty_loss)
+    findings, _ = lint_program(step, state, abstract_batch(mesh, 8, 32),
+                               mesh, strategy="dp")
+    rules = sorted({f.rule for f in findings})
+    if rules != ["XFR001"]:
+        print(f"[lint-demo] FAIL: planted host callback tripped {rules}, "
+              "not exactly XFR001", file=sys.stderr)
+        ok = False
+    else:
+        print(f"[lint-demo] injected host callback -> {rules} OK",
+              flush=True)
+
+    # -- 3. the artifact must gate through bench compare ------------------
+    if not os.path.exists(artifact):
+        print("[lint-demo] FAIL: lint wrote no artifact; compare gate "
+              "not exercised", file=sys.stderr)
+        return 1
+    if compare_main([artifact, artifact]) != 0:
+        print("[lint-demo] FAIL: lint artifact self-compare regressed",
+              file=sys.stderr)
+        ok = False
+    with open(artifact) as f:
+        base = json.load(f)
+    poisoned = copy.deepcopy(base)
+    prog = poisoned["programs"]["dp"]
+    prog["rule_counts"] = dict(prog.get("rule_counts") or {})
+    prog["rule_counts"]["DON001"] = \
+        prog["rule_counts"].get("DON001", 0) + 1
+    poisoned_path = os.path.join(args.dir, "lint_poisoned.json")
+    with open(poisoned_path, "w") as f:
+        json.dump(poisoned, f)
+    if compare_main([artifact, poisoned_path]) != 1:
+        print("[lint-demo] FAIL: bench compare did not flag a new lint "
+              "finding", file=sys.stderr)
+        ok = False
+
+    if ok:
+        print(
+            "[lint-demo] OK: all strategy programs + source tier clean, "
+            "injected DON001/XFR001 violations trip their rules, and a "
+            "new finding in the committed artifact fails bench compare"
+        )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

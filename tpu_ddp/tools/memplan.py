@@ -221,7 +221,16 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
     hbm = hbm_bytes_per_chip(kind)
     # Steady state: donated inputs alias outputs, so peak is roughly
     # args + temp (the compiler's temp already includes the working set).
+    # The "donation" section shows the compiler's own accounting for that
+    # assumption — argument bytes XLA aliased input->output vs the batch
+    # remainder; `tpu-ddp lint`'s DON001 gates on exactly this report,
+    # so a dropped donate_argnums fails the lint AND shows up here as a
+    # fat non_donated_bytes.
     peak = arg + temp
+    from tpu_ddp.analysis.lint import donation_report
+
+    donation = donation_report(
+        compiled, batch, dict(zip(mesh.axis_names, mesh.devices.shape)))
     grad_compress_report = None
     if grad_compress:
         # Static per-step wire-bytes table across every mode x layout
@@ -256,6 +265,7 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
             "temp_bytes": temp,
             "est_peak_bytes": peak,
         },
+        "donation": donation,
         "hbm_bytes": hbm,
         "fits": (peak < hbm) if hbm else None,
         "hbm_fraction": round(peak / hbm, 4) if hbm else None,

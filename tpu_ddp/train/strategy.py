@@ -560,13 +560,24 @@ def build_abstract_step(
     health=None,
     pp_schedule: str = "gpipe",
     sp_flash: bool = False,
+    donate: bool = True,
 ):
     """(train step, ABSTRACT TrainState) for any strategy — the
     compile-only twin of :func:`build_strategy`, shared by
-    ``tools/memplan.py``, ``analysis/hlo.py``, and ``benchmarks/``.
-    ``health``/``pp_schedule``/``sp_flash`` thread exactly like
-    :func:`build_strategy`'s — they change the compiled program, so the
-    twin must honor them too.
+    ``tools/memplan.py``, ``analysis/hlo.py``, ``analysis/lint.py``, and
+    ``benchmarks/``. ``health``/``pp_schedule``/``sp_flash`` thread
+    exactly like :func:`build_strategy`'s — they change the compiled
+    program, so the twin must honor them too.
+
+    ``donate`` mirrors the Trainer's donation contract EXPLICITLY: the
+    product always jits its step with ``donate_argnums=(0,)`` (the train
+    state), and every family builder defaults to that — but the twin
+    threads the flag to every builder rather than relying on those
+    defaults, so a default drift in one family cannot silently diverge
+    the analyzed program from the trained one (pinned by
+    tests/test_lint.py's abstract-vs-live alias parity test). Passing
+    ``donate=False`` exists for the lint tier's injected DON001
+    violation only.
 
     States are abstract end to end (``jax.eval_shape`` + the builder's
     shardings attached via ``abstract_train_state``), so this is safe on
@@ -642,11 +653,11 @@ def build_abstract_step(
             step = make_grad_accum_train_step(
                 model, tx, mesh, accum_steps=grad_accum_steps,
                 loss_fn=loss_fn, remat=remat, zero1=part, compress=comp,
-                health=health)
+                health=health, donate=donate)
         else:
             step = make_train_step(model, tx, mesh, loss_fn=loss_fn,
                                    remat=remat, zero1=part, compress=comp,
-                                   health=health)
+                                   health=health, donate=donate)
         return step, abstract_train_state(state, shardings)
 
     has_bs_state = jax.eval_shape(
@@ -664,6 +675,7 @@ def build_abstract_step(
         step, shardings = make_fsdp_train_step(
             model, tx, mesh, state, loss_fn=loss_fn, has_batch_stats=has_bs,
             remat=remat, grad_accum_steps=grad_accum_steps, health=health,
+            donate=donate,
         )
         return step, abstract_train_state(state, shardings)
 
@@ -679,7 +691,7 @@ def build_abstract_step(
         step, shardings = mk(model, tx, mesh, state, rules=rules,
                              loss_fn=loss_fn, has_batch_stats=has_bs,
                              remat=remat, grad_accum_steps=grad_accum_steps,
-                             health=health)
+                             health=health, donate=donate)
         return step, abstract_train_state(state, shardings)
 
     if parallelism == "pp":
@@ -709,6 +721,7 @@ def build_abstract_step(
         step, shardings = make_pp_train_step(
             model, tx, mesh, pp_state, n_microbatches=n_microbatches,
             loss_fn=loss_fn, schedule=pp_schedule, health=health,
+            donate=donate,
         )
         return step, abstract_train_state(pp_state, shardings)
 
@@ -724,6 +737,7 @@ def build_abstract_step(
         step, shardings = make_ep_train_step(
             model, tx, mesh, state, loss_fn=loss_fn,
             remat=remat, grad_accum_steps=grad_accum_steps, health=health,
+            donate=donate,
         )
         return step, abstract_train_state(state, shardings)
 
@@ -738,7 +752,7 @@ def build_abstract_step(
             )
         step = make_sp_train_step(
             model.clone(sp_axis=SEQUENCE_AXIS, sp_flash=sp_flash), tx, mesh,
-            loss_fn=loss_fn, health=health,
+            loss_fn=loss_fn, health=health, donate=donate,
         )
         return step, abstract_train_state(state)
 

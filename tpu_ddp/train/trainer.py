@@ -204,6 +204,13 @@ class TrainConfig:
                                           # dir; defaults to telemetry_dir
     health_window: int = 128              # spike detector rolling window
     health_spike_threshold: float = 10.0  # spike at median + K * MAD
+    lint_on_start: bool = False           # preflight: run the static
+                                          # graph lint (docs/lint.md —
+                                          # donation / dtype / sharding /
+                                          # collective-order / host-
+                                          # transfer rules) over the REAL
+                                          # jitted step and refuse to
+                                          # launch a violating program
 
     def validate(self) -> "TrainConfig":
         """Fail fast on knob values that would otherwise only explode
@@ -1185,9 +1192,91 @@ class Trainer:
         if self._best_acc != float("-inf"):
             tel.gauge("eval/best_test_accuracy").set(self._best_acc)
 
+    def lint_preflight(self, *, raise_on_error: bool = True):
+        """Run the static graph lint (``tpu_ddp/analysis/lint.py``) over
+        the REAL jitted train step(s) — not the abstract twin — so the
+        verdict applies to the exact program this run trains with.
+
+        Cost: one EXTRA ahead-of-time compile per linted program (the
+        AOT path does not seed jit's dispatch cache, so step 1 still
+        compiles) — ``--compilation-cache-dir`` makes the second compile
+        a cache hit, which is the recommended pairing. Returns the
+        findings; with ``raise_on_error`` (the ``--lint-on-start`` path)
+        an error finding refuses the launch."""
+        import jax as _jax
+
+        from tpu_ddp.analysis.explain import run_strategy_label
+        from tpu_ddp.analysis.lint import lint_program, render_findings
+
+        c = self.config
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        replicated = NamedSharding(self.mesh, _P())
+
+        def _aval(x):
+            # dp keeps the replicated state uncommitted (single-device
+            # shardings); pin those to the mesh-replicated layout the
+            # step runs them in — mesh layouts (zero1 shards, GSPMD
+            # specs) pass through
+            sh = getattr(x, "sharding", None)
+            if not isinstance(sh, NamedSharding):
+                sh = replicated
+            return _jax.ShapeDtypeStruct(_jax.numpy.shape(x), x.dtype,
+                                         sharding=sh)
+
+        state = _jax.tree.map(_aval, self.state)
+        gb = c.per_shard_batch * self.data_size
+        shard_of = (self.batch_sharding.get
+                    if isinstance(self.batch_sharding, dict)
+                    else lambda _k: self.batch_sharding)
+        # label avals must mirror the run's loss: bce trains on multi-hot
+        # float targets (N, C), ce on class indices (N,)
+        label_shape, label_dtype = (
+            ((gb, c.num_classes), _jax.numpy.float32) if c.loss == "bce"
+            else ((gb,), _jax.numpy.int32))
+        batch = {
+            "image": _jax.ShapeDtypeStruct(
+                (gb, 32, 32, 3), _jax.numpy.float32,
+                sharding=shard_of("image")),
+            "label": _jax.ShapeDtypeStruct(
+                label_shape, label_dtype, sharding=shard_of("label")),
+            "mask": _jax.ShapeDtypeStruct(
+                (gb,), bool, sharding=shard_of("mask")),
+        }
+        label = run_strategy_label(self.run_meta)
+        findings, _ = lint_program(
+            self.train_step, state, batch, self.mesh, strategy=label,
+            compute_dtype=c.compute_dtype, model_name=c.model,
+        )
+        if self.multi_step is not None:
+            stacked = {
+                k: _jax.ShapeDtypeStruct(
+                    (self.steps_per_call,) + v.shape, v.dtype,
+                    sharding=self.stacked_sharding)
+                for k, v in batch.items()
+            }
+            scan_findings, _ = lint_program(
+                self.multi_step, state, stacked, self.mesh, strategy=label,
+                compute_dtype=c.compute_dtype, model_name=c.model,
+                program=f"{label}+scan",
+            )
+            findings = findings + scan_findings
+        print(render_findings(f"preflight ({label})", findings),
+              flush=True)
+        errors = [f for f in findings if f.severity == "error"]
+        if errors and raise_on_error:
+            raise RuntimeError(
+                f"lint preflight refused the launch: {len(errors)} "
+                "error finding(s) in the compiled step (see above; "
+                "docs/lint.md has the rule table and fix hints)"
+            )
+        return findings
+
     def _run_impl(self) -> dict:
         c = self.config
         start = time.time()
+        if c.lint_on_start:
+            self.lint_preflight()
         # Preemption safety (beyond SURVEY §5.3's reference scope, which has
         # no failure handling at all): SIGTERM/SIGINT set a flag; the loop
         # drains at the next safe boundary, the tail saves a final
