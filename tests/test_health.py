@@ -484,3 +484,35 @@ def test_monitor_one_shot_dump_and_summarize(tmp_path):
                for line in open(os.path.join(run_dir, "health-p0.jsonl"))]
     with_layers = [r["step"] for r in records if "per_layer" in r]
     assert with_layers == [0, 2, 4, 6, 7]
+
+
+def test_health_summarize_multihost_skew_line(tmp_path):
+    """Satellite: a multihost health dir merges every health-p<i>.jsonl
+    and names the host whose grad-norm p50 diverges from the fleet
+    median — the stats are replicated globals, so any real delta means
+    a diverged host."""
+    import json
+
+    from tpu_ddp.health.summarize import summarize_health
+
+    for host, gn in enumerate((1.0, 1.0, 1.0, 9.0)):
+        with open(tmp_path / f"health-p{host}.jsonl", "w") as f:
+            f.write(json.dumps({"schema_version": 1, "type": "header",
+                                "pid": host, "policy": "warn"}) + "\n")
+            for step in range(8):
+                f.write(json.dumps({
+                    "schema_version": 1, "type": "health", "step": step,
+                    "pid": host, "loss": 2.0, "grad_norm": gn,
+                    "all_finite": True,
+                }) + "\n")
+    out = summarize_health(str(tmp_path))
+    assert "per-host skew: grad_norm" in out
+    assert "host 3" in out
+
+    solo = tmp_path / "solo"
+    solo.mkdir()
+    with open(solo / "health-p0.jsonl", "w") as f:
+        f.write(json.dumps({"schema_version": 1, "type": "health",
+                            "step": 0, "pid": 0, "loss": 2.0,
+                            "grad_norm": 1.0, "all_finite": True}) + "\n")
+    assert "per-host skew" not in summarize_health(str(solo))

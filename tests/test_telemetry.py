@@ -391,3 +391,125 @@ def test_compilation_cache_counters(tmp_path, devices):
         except Exception:
             pass
         reset_default_registry()
+
+
+def test_watchdog_heartbeat_freshness_contract(tmp_path):
+    """The heartbeat file contract the fleet monitor builds on: every
+    beat refreshes the file (modulo the 1-write/sec rate limit), the
+    record carries the last completed step + wall time, and the
+    staleness predicates flip exactly at the configured deadline."""
+    from tpu_ddp.telemetry.watchdog import heartbeat_age_seconds, read_heartbeat
+
+    wd = HangWatchdog(0.3, heartbeat_dir=str(tmp_path), poll_interval=10.0)
+    path = tmp_path / "heartbeat-p0.json"
+
+    wd.beat(step=1)
+    rec1 = read_heartbeat(str(path))
+    assert rec1["step"] == 1 and rec1["pid"] > 0
+    assert heartbeat_age_seconds(rec1) < 5.0
+
+    # within the rate limit the file does NOT advance (atomic writes are
+    # throttled to 1/sec so a hot step loop can't thrash the filesystem)
+    wd.beat(step=2)
+    assert read_heartbeat(str(path))["step"] == 1
+    # past the limiter it must advance (simulate >1s elapsing)
+    wd._last_file_write -= 2.0
+    wd.beat(step=3)
+    assert read_heartbeat(str(path))["step"] == 3
+
+    # freshness predicates: fresh now, stale exactly past the deadline
+    assert wd.seconds_since_beat() < 0.3 and not wd.is_stale()
+    wd._last_beat -= 0.5  # no beat for 0.5s > 0.3s deadline
+    assert wd.is_stale()
+    wd.beat(step=4)  # a beat re-arms freshness
+    assert not wd.is_stale()
+
+    # stop() force-flushes the FINAL step past the rate limiter
+    wd.beat(step=5)
+    wd.stop()
+    assert read_heartbeat(str(path))["step"] == 5
+
+
+def test_watchdog_staleness_fires_at_deadline_not_before(tmp_path):
+    wd = HangWatchdog(0.25, poll_interval=0.02).start()
+    try:
+        wd.beat(0)
+        time.sleep(0.15)  # inside the deadline: silent and fresh
+        assert not wd.fired and not wd.is_stale()
+        time.sleep(0.25)  # now past it: predicate and dump agree
+        assert wd.is_stale()
+        assert wd.fired
+    finally:
+        wd.stop()
+
+
+def _write_multihost_traces(tmp_path, p50s_ms):
+    for host, ms in enumerate(p50s_ms):
+        with open(tmp_path / f"trace-p{host}.jsonl", "w") as f:
+            f.write(json.dumps({"schema_version": 1, "type": "header",
+                                "epoch_unix": 0.0, "pid": host}) + "\n")
+            for step in range(10):
+                f.write(json.dumps({
+                    "schema_version": 1, "type": SPAN,
+                    "name": "compiled_step", "ts_s": step * 0.1,
+                    "dur_s": ms / 1e3, "pid": host, "tid": 1, "depth": 0,
+                    "step": step,
+                }) + "\n")
+
+
+def test_trace_summarize_multihost_skew_line(tmp_path):
+    """Satellite: a multihost run dir summarizes every trace-p<i>.jsonl
+    AND names the skewed host (max p50 delta vs the fleet median)."""
+    from tpu_ddp.telemetry.summarize import summarize
+
+    _write_multihost_traces(tmp_path, [10.0, 10.0, 10.0, 31.0])
+    out = summarize(str(tmp_path))
+    assert "per-host skew: compiled_step" in out
+    assert "host 3" in out
+    assert "21.00ms" in out  # 31ms vs the 10ms fleet median
+
+    # single-host dirs stay skew-line-free (nothing to compare)
+    solo = tmp_path / "solo"
+    solo.mkdir()
+    _write_multihost_traces(solo, [10.0])
+    assert "per-host skew" not in summarize(str(solo))
+
+
+def test_summarize_prefers_last_periodic_snapshot(tmp_path):
+    """Satellite: a killed run's newest counters record is a periodic
+    ``counters_snapshot`` — the summary shows it (with its step) instead
+    of pretending there was a clean final snapshot."""
+    from tpu_ddp.telemetry.summarize import summarize
+
+    with open(tmp_path / "trace-p0.jsonl", "w") as f:
+        f.write(json.dumps({"schema_version": 1, "type": "header",
+                            "epoch_unix": 0.0, "pid": 0}) + "\n")
+        f.write(json.dumps({
+            "schema_version": 1, "type": SPAN, "name": "compiled_step",
+            "ts_s": 0.0, "dur_s": 0.01, "pid": 0, "tid": 1, "depth": 0,
+        }) + "\n")
+        for step, steps_total in ((50, 50), (100, 100)):
+            f.write(json.dumps({
+                "schema_version": 1, "type": "counters",
+                "name": "counters_snapshot", "ts_s": float(step),
+                "pid": 0, "tid": 1, "step": step,
+                "attrs": {"counters": {"train/steps": steps_total},
+                          "gauges": {}, "histograms": {}},
+            }) + "\n")
+        # no final "counters" record: the run was SIGKILLed here
+    out = summarize(str(tmp_path))
+    assert "last periodic snapshot @ step 100" in out
+    assert "did not shut down cleanly" in out
+    assert "train/steps = 100" in out
+
+
+def test_telemetry_periodic_snapshot_event_name():
+    """Telemetry.emit_counters(name=...) labels the record so readers
+    can tell periodic tails from clean-shutdown snapshots."""
+    cap = CaptureSink()
+    tel = Telemetry([cap], registry=Registry())
+    tel.count("train/steps", 2)
+    tel.emit_counters(name="counters_snapshot")
+    tel.emit_counters()
+    assert [e.name for e in cap.events] == ["counters_snapshot", "counters"]
+    assert all(e.kind == "counters" for e in cap.events)

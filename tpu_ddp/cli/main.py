@@ -10,6 +10,12 @@ Subcommands:
 - ``tpu-ddp health <run_dir>`` — render a monitored run's numerics
   timeline (loss/grad-norm percentiles + sparkline, non-finite and
   loss-spike steps) and any anomaly dumps (docs/health.md).
+- ``tpu-ddp watch <run_dir>`` — LIVE fleet monitor: tails the run
+  dir's per-host telemetry/health/heartbeat files into a rolling
+  snapshot (per-host steps/sec, phase p50s, data-wait share), flags
+  stragglers and lost hosts, and runs the alert rules
+  (``alerts.jsonl``); ``--once --json`` for scripting and CI
+  (docs/monitoring.md).
 - ``tpu-ddp analyze [run_dir]`` — static step-time anatomy: XLA
   cost-model flops/bytes, collective inventory, roofline bound
   classification, per-strategy collective fingerprint; given a run dir,
@@ -27,7 +33,8 @@ Subcommands:
   collectives, widened payload dtypes, memory/flops growth, new lint
   findings).
 
-``trace summarize``, ``health``, and ``bench compare`` are stdlib-only
+``trace summarize``, ``health``, ``watch``, and ``bench compare`` are
+stdlib-only
 end to end (no jax import): records are summarized wherever they land —
 a laptop, a CI box, the pod host itself. The train/launch/analyze
 subcommands import lazily so the read-back commands keep that property.
@@ -85,6 +92,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_ddp.analysis.lint import main as lint_main
 
         return lint_main(argv[1:])
+    # watch owns its argparse surface and stays stdlib-only (no jax
+    # import unless --roofline is passed)
+    if argv[:1] == ["watch"]:
+        from tpu_ddp.monitor.watch import main as watch_main
+
+        return watch_main(argv[1:])
     if argv[:2] == ["bench", "compare"]:
         from tpu_ddp.analysis.regress import main as compare_main
 
@@ -115,6 +128,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     health.add_argument("path", help="run dir (holding health-p*.jsonl) "
                                      "or a health file")
     health.set_defaults(func=_health_summarize)
+    sub.add_parser(
+        "watch",
+        help="live fleet monitor over a run dir: per-host steps/sec + "
+             "phase p50s, straggler/lost-host flags, alert rules "
+             "(tpu-ddp watch --help)",
+    )
     sub.add_parser(
         "analyze",
         help="static step anatomy + roofline + collective fingerprint, "

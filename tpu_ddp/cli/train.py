@@ -201,6 +201,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--telemetry-sinks", default="jsonl,chrome,summary",
                    metavar="LIST",
                    help="comma-separated subset of jsonl,chrome,summary")
+    p.add_argument("--telemetry-snapshot-steps", type=int, default=50,
+                   metavar="N",
+                   help="flush a counters snapshot into the JSONL trace "
+                        "every N steps so a killed/preempted run leaves "
+                        "a usable tail for `tpu-ddp watch` and `trace "
+                        "summarize` (0 disables; epoch-end and final "
+                        "snapshots always happen)")
+    p.add_argument("--monitor-port", type=int, default=0, metavar="PORT",
+                   help="per-host live monitor HTTP endpoint: /metrics "
+                        "(OpenMetrics, labeled with run id/strategy/"
+                        "mesh/host), /snapshot.json, /healthz (watchdog "
+                        "heartbeat freshness). 0 = disabled, -1 = "
+                        "ephemeral port (recorded in exporter-p<i>.json "
+                        "under --telemetry-dir). See docs/monitoring.md "
+                        "and `tpu-ddp watch`")
+    p.add_argument("--monitor-bind", default="0.0.0.0", metavar="ADDR",
+                   help="monitor endpoint bind address. The endpoint is "
+                        "UNauthenticated and /snapshot.json serves the "
+                        "run config — bind 127.0.0.1 (and scrape via a "
+                        "tunnel) on untrusted networks")
     p.add_argument("--watchdog-deadline", type=float, default=0.0,
                    metavar="SECONDS",
                    help=">0: hang watchdog — every host writes a "
@@ -402,6 +422,9 @@ def config_from_args(args) -> TrainConfig:
         profile_dir=args.profile_dir,
         telemetry_dir=args.telemetry_dir,
         telemetry_sinks=args.telemetry_sinks,
+        telemetry_snapshot_steps=args.telemetry_snapshot_steps,
+        monitor_port=args.monitor_port,
+        monitor_bind=args.monitor_bind,
         watchdog_deadline_seconds=args.watchdog_deadline,
         health=args.health,
         health_policy=args.health_policy,

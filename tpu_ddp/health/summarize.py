@@ -108,6 +108,25 @@ def summarize_health(path: str) -> str:
         by_step.setdefault(r.get("step", 0), r)
     ordered = [by_step[s] for s in sorted(by_step)]
 
+    # BEFORE the collapse: per-host grad-norm p50 skew. The stats are
+    # replicated globals, so any real delta means a host diverged from
+    # the fleet (stale program, bad chip) — worth one line up front.
+    from tpu_ddp.monitor.aggregate import host_skew
+    from tpu_ddp.telemetry.registry import Histogram as _Hist
+
+    per_host: Dict[int, _Hist] = {}
+    for r in steps:
+        v = r.get("grad_norm")
+        if isinstance(v, (int, float)) and math.isfinite(v):
+            per_host.setdefault(r.get("pid", 0), _Hist()).record(v)
+    skew = host_skew({pid: h.percentile(50)
+                      for pid, h in per_host.items() if h.count})
+    if skew:
+        lines.append(
+            f"per-host skew: grad_norm p50 max delta {skew['max_delta']:.3g}"
+            f" vs fleet median {skew['median']:.3g} (host {skew['host']})"
+        )
+
     nonfinite = [r["step"] for r in ordered if not r.get("all_finite", True)]
     spikes = [r["step"] for r in ordered
               if r.get("anomaly") == "loss_spike"]

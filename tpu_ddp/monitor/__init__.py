@@ -1,0 +1,63 @@
+"""Live fleet monitor: the while-it-runs observability surface.
+
+Everything else in-tree is post-hoc (``tpu-ddp trace summarize`` /
+``tpu-ddp health`` read JSONL after the run) or static (``tpu-ddp
+analyze`` / ``tpu-ddp lint`` inspect the compiled program before it).
+This package watches a run *while it is running*:
+
+- ``exporter``  — a stdlib-only per-host HTTP endpoint
+  (``TrainConfig.monitor_port`` / ``--monitor-port``) serving
+  ``/metrics`` (OpenMetrics text from the telemetry registry, labeled
+  with the run-metadata header), ``/snapshot.json``, and ``/healthz``
+  (backed by the watchdog heartbeat).
+- ``aggregate`` — a fleet aggregator that tails a run dir's per-host
+  telemetry/health/heartbeat files into a rolling ``FleetSnapshot``
+  (per-host step, phase p50s, data-wait share, steps/sec, heartbeat
+  age) and flags stragglers (k×MAD off the fleet median) and lost
+  hosts (stale heartbeat).
+- ``alerts``    — a declarative rule engine (threshold / trend /
+  staleness rules with ids and severities, mirroring the lint-rule
+  registry) over snapshots, emitting schema-versioned ``alerts.jsonl``
+  plus log/file/webhook actions.
+- ``watch``     — ``tpu-ddp watch <run_dir>``: a live terminal
+  dashboard, with ``--once --json`` for scripting and CI.
+
+Stdlib-only end to end (the one exception: ``watch --roofline`` lazily
+imports the jax-backed analysis join) — snapshots are read wherever the
+run dir lands, exactly like ``trace summarize``. Snapshots and alerts
+are schema-versioned from day one: this is the read side the future
+elastic controller and serving engine consume. See ``docs/monitoring.md``.
+"""
+
+from tpu_ddp.monitor.aggregate import (
+    SNAPSHOT_SCHEMA_VERSION,
+    FleetAggregator,
+    FleetSnapshot,
+    HostSnapshot,
+    MonitorConfig,
+    host_skew,
+    read_fleet_snapshot,
+)
+from tpu_ddp.monitor.alerts import (
+    ALERT_RULES,
+    ALERT_SCHEMA_VERSION,
+    Alert,
+    AlertEngine,
+)
+from tpu_ddp.monitor.exporter import MonitorExporter, render_openmetrics
+
+__all__ = [
+    "SNAPSHOT_SCHEMA_VERSION",
+    "ALERT_SCHEMA_VERSION",
+    "ALERT_RULES",
+    "Alert",
+    "AlertEngine",
+    "FleetAggregator",
+    "FleetSnapshot",
+    "HostSnapshot",
+    "MonitorConfig",
+    "MonitorExporter",
+    "host_skew",
+    "read_fleet_snapshot",
+    "render_openmetrics",
+]

@@ -1,0 +1,358 @@
+"""Declarative alert rules over fleet snapshots -> ``alerts.jsonl``.
+
+The rule registry mirrors the graph-lint registry
+(``analysis/lint.py::RULES``): every rule has a stable id, a severity,
+a kind (``threshold`` / ``trend`` / ``staleness``), and a one-line fix
+hint — the single source behind the findings, the ``tpu-ddp watch``
+display, and the docs/monitoring.md rule table. Stable ids are the
+contract: CI (``make monitor-demo``) injects a straggler and a NaN
+spike and asserts exactly their ids fire, and downstream automation
+(the future elastic controller's re-mesh trigger) keys on them.
+
+The :class:`AlertEngine` is edge-triggered: a condition FIRES once when
+it first holds, stays in the ``active()`` set while it persists, and
+emits one RESOLVED record when it clears — a flapping fleet produces a
+readable alert log, not one line per poll. Every edge goes through the
+configured actions: ``log`` (process logger), ``file``
+(schema-versioned ``alerts.jsonl`` appended in the run dir — the
+durable record the post-mortem reads), and ``webhook`` (JSON POST to
+``MonitorConfig.webhook_url``, best-effort). Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import statistics
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from tpu_ddp.monitor.aggregate import FleetSnapshot, MonitorConfig
+
+log = logging.getLogger(__name__)
+
+#: bump on any breaking change to the alerts.jsonl record shape
+ALERT_SCHEMA_VERSION = 1
+
+#: rule registry: id -> (what it catches, severity, kind, fix hint) —
+#: the single source behind findings and the docs/monitoring.md table
+ALERT_RULES: Dict[str, Dict[str, str]] = {
+    "FLT001": {
+        "title": "host lost",
+        "severity": "critical",
+        "kind": "staleness",
+        "fix": "check the host for preemption/crash (hang-p<i>.log, "
+               "scheduler events); restart it or re-mesh the job to the "
+               "survivors and --resume",
+    },
+    "STR001": {
+        "title": "persistent straggler",
+        "severity": "warning",
+        "kind": "threshold",
+        "fix": "a host's compiled_step/data_wait p50 has sat > k*MAD "
+               "above the fleet median for N windows: check its input "
+               "pipeline, thermal state, and neighbors on the ICI/DCN "
+               "path; drain-and-replace if it persists",
+    },
+    "THR001": {
+        "title": "fleet steps/sec collapse",
+        "severity": "critical",
+        "kind": "trend",
+        "fix": "throughput fell below the collapse fraction of its "
+               "rolling baseline: look for a new straggler/lost host, "
+               "storage slowdown, or a recompile storm "
+               "(jax/cache counters in /metrics)",
+    },
+    "DWT001": {
+        "title": "data-wait share high",
+        "severity": "warning",
+        "kind": "threshold",
+        "fix": "the step loop is input-bound: raise --prefetch-depth, "
+               "check the data filesystem, or move decode work off the "
+               "trainer hosts",
+    },
+    "NUM001": {
+        "title": "grad-norm spike",
+        "severity": "warning",
+        "kind": "trend",
+        "fix": "gradient norm jumped > k*MAD over its rolling window: "
+               "inspect `tpu-ddp health <run_dir>` and the anomaly "
+               "dump; consider --grad-clip-norm or a lower lr",
+    },
+    "NUM002": {
+        "title": "non-finite sentinel",
+        "severity": "critical",
+        "kind": "threshold",
+        "fix": "a NaN/Inf step was recorded: the health policy decides "
+               "the in-run response (--health-policy skip_step/halt); "
+               "the anomaly dump under <run_dir>/anomalies/ has the "
+               "offending batch and stats",
+    },
+    "CKP001": {
+        "title": "checkpoint overdue",
+        "severity": "warning",
+        "kind": "staleness",
+        "fix": "no checkpoint span within the configured budget: a "
+               "preemption now loses that much work — check the "
+               "checkpoint storage path and --checkpoint-every-epochs",
+    },
+}
+
+
+@dataclasses.dataclass
+class Alert:
+    """One edge (firing or resolved) of one rule on one scope."""
+
+    rule: str
+    severity: str
+    state: str                      # "firing" | "resolved"
+    message: str
+    host: Optional[int] = None      # None = fleet-scoped
+    value: Optional[float] = None
+    step: Optional[int] = None
+    wall_time: float = 0.0
+
+    def to_record(self) -> dict:
+        rec = {
+            "schema_version": ALERT_SCHEMA_VERSION,
+            "type": "alert",
+            **dataclasses.asdict(self),
+        }
+        rec["title"] = ALERT_RULES[self.rule]["title"]
+        rec["fix"] = ALERT_RULES[self.rule]["fix"]
+        return rec
+
+
+class AlertEngine:
+    """Evaluate the rule registry against each snapshot; edge-triggered.
+
+    ``once=True`` is the ``watch --once`` / CI mode: persistence
+    requirements collapse to a single observation (a one-shot pass over
+    a static run dir must still surface a straggler that would need N
+    live windows to qualify).
+    """
+
+    def __init__(
+        self,
+        config: Optional[MonitorConfig] = None,
+        *,
+        run_dir: Optional[str] = None,
+        actions: Tuple[str, ...] = ("log", "file"),
+        once: bool = False,
+    ):
+        self.config = config or MonitorConfig()
+        self.run_dir = run_dir
+        self.actions = tuple(actions)
+        self.once = once
+        self._active: Dict[Tuple[str, Optional[int]], Alert] = {}
+        self._straggler_runs: Dict[int, int] = {}
+        self._rate_baseline: deque = deque(
+            maxlen=max(self.config.baseline_polls, 3))
+
+    # -- rule evaluation --------------------------------------------------
+
+    def _conditions(
+        self, snap: FleetSnapshot
+    ) -> Dict[Tuple[str, Optional[int]], Tuple[str, Optional[float]]]:
+        """{(rule, host): (message, value)} for every condition that
+        holds on this snapshot."""
+        cfg = self.config
+        found: Dict[Tuple[str, Optional[int]],
+                    Tuple[str, Optional[float]]] = {}
+
+        for h in snap.hosts:
+            if h.lost:
+                age = (h.heartbeat_age_s if h.heartbeat_age_s is not None
+                       else h.last_event_age_s)
+                found[("FLT001", h.host)] = (
+                    f"host {h.host} lost: heartbeat stale "
+                    f"{age:.0f}s (deadline "
+                    f"{cfg.heartbeat_stale_seconds:.0f}s)"
+                    if age is not None else f"host {h.host} lost",
+                    age,
+                )
+
+            # straggler persistence: consecutive flagged polls
+            runs = self._straggler_runs.get(h.host, 0)
+            runs = runs + 1 if h.straggler else 0
+            self._straggler_runs[h.host] = runs
+            need = 1 if self.once else cfg.straggler_persist_windows
+            if h.straggler and runs >= need:
+                phase = (h.straggler_phases[0] if h.straggler_phases
+                         else "compiled_step")
+                p50 = h.phase_p50_s.get(phase)
+                med = (snap.fleet.get("phase_p50_s") or {}).get(phase)
+
+                def ms(v):
+                    return f"{1e3 * v:.1f}ms" if v else "n/a"
+
+                found[("STR001", h.host)] = (
+                    f"host {h.host} straggling on "
+                    f"{','.join(h.straggler_phases) or phase} "
+                    f"({runs} consecutive window(s), p50 {ms(p50)} vs "
+                    f"fleet median {ms(med)})",
+                    p50,
+                )
+
+            if (h.data_wait_share is not None
+                    and h.data_wait_share > cfg.data_wait_share_max):
+                found[("DWT001", h.host)] = (
+                    f"host {h.host} data-wait share "
+                    f"{h.data_wait_share:.0%} > "
+                    f"{cfg.data_wait_share_max:.0%} of the step loop",
+                    h.data_wait_share,
+                )
+
+            if h.health.get("grad_norm_spike"):
+                found[("NUM001", h.host)] = (
+                    f"host {h.host} grad norm spiked to "
+                    f"{h.health.get('last_grad_norm')} "
+                    f"(> {cfg.grad_norm_mad_threshold:g}*MAD over its "
+                    "rolling window)",
+                    h.health.get("last_grad_norm"),
+                )
+
+            # latched, not edge-on-delta: NaNs never un-happen, so the
+            # alert must stay in the active set (and never emit a bogus
+            # "resolved" record) for the rest of the watch session
+            nonfinite = int(h.health.get("nonfinite_steps") or 0)
+            if nonfinite > 0:
+                found[("NUM002", h.host)] = (
+                    f"host {h.host} recorded {nonfinite} non-finite "
+                    "step(s)",
+                    float(nonfinite),
+                )
+
+        rate = snap.fleet.get("steps_per_sec")
+        if isinstance(rate, (int, float)):
+            baseline = (statistics.median(self._rate_baseline)
+                        if len(self._rate_baseline) >= 3 else None)
+            if (baseline and baseline > 0
+                    and rate < cfg.steps_per_sec_collapse_frac * baseline):
+                found[("THR001", None)] = (
+                    f"fleet steps/sec collapsed to {rate:.2f} "
+                    f"(< {cfg.steps_per_sec_collapse_frac:.0%} of rolling "
+                    f"baseline {baseline:.2f})",
+                    rate,
+                )
+            # baseline freezes while collapsed: absorbing the collapsed
+            # rate would lower the median until the alert falsely
+            # self-resolves with throughput still on the floor
+            if (("THR001", None) not in found
+                    and ("THR001", None) not in self._active):
+                self._rate_baseline.append(rate)
+
+        if cfg.checkpoint_overdue_seconds > 0:
+            ckpt_age = snap.fleet.get("checkpoint_age_s")
+            if isinstance(ckpt_age, (int, float)):
+                if ckpt_age > cfg.checkpoint_overdue_seconds:
+                    found[("CKP001", None)] = (
+                        f"last checkpoint {ckpt_age:.0f}s ago (budget "
+                        f"{cfg.checkpoint_overdue_seconds:.0f}s) — that "
+                        "much work is at preemption risk",
+                        ckpt_age,
+                    )
+            else:
+                # no checkpoint span EVER recorded — the worst case the
+                # rule exists for; age the condition off the run start
+                run_age = snap.fleet.get("run_age_s")
+                if (isinstance(run_age, (int, float))
+                        and run_age > cfg.checkpoint_overdue_seconds):
+                    found[("CKP001", None)] = (
+                        f"no checkpoint recorded in {run_age:.0f}s of "
+                        f"run (budget "
+                        f"{cfg.checkpoint_overdue_seconds:.0f}s) — is "
+                        "checkpointing configured?",
+                        run_age,
+                    )
+        return found
+
+    # -- engine -----------------------------------------------------------
+
+    def evaluate(self, snap: FleetSnapshot) -> List[Alert]:
+        """Fold one snapshot in; returns the EDGES (newly firing +
+        newly resolved alerts) this poll produced. ``active()`` holds
+        the standing set."""
+        conditions = self._conditions(snap)
+        step = snap.fleet.get("step_max")
+        edges: List[Alert] = []
+        for key, (message, value) in conditions.items():
+            if key in self._active:
+                continue  # still firing — no new edge
+            rule, host = key
+            alert = Alert(
+                rule=rule,
+                severity=ALERT_RULES[rule]["severity"],
+                state="firing",
+                message=message,
+                host=host,
+                value=value,
+                step=step if isinstance(step, int) else None,
+                wall_time=snap.wall_time,
+            )
+            self._active[key] = alert
+            edges.append(alert)
+        for key in [k for k in self._active if k not in conditions]:
+            fired = self._active.pop(key)
+            edges.append(dataclasses.replace(
+                fired, state="resolved", wall_time=snap.wall_time,
+                message=f"resolved: {fired.message}",
+            ))
+        for alert in edges:
+            self._emit(alert)
+        return edges
+
+    def active(self) -> List[Alert]:
+        """The standing firing set, most severe first."""
+        order = {"critical": 0, "warning": 1}
+        return sorted(
+            self._active.values(),
+            key=lambda a: (order.get(a.severity, 2), a.rule,
+                           a.host if a.host is not None else -1),
+        )
+
+    # -- actions ----------------------------------------------------------
+
+    def _emit(self, alert: Alert) -> None:
+        if "log" in self.actions:
+            level = (logging.ERROR if alert.severity == "critical"
+                     and alert.state == "firing" else logging.WARNING)
+            log.log(level, "alert %s [%s] %s: %s", alert.rule,
+                    alert.severity, alert.state, alert.message)
+        if "file" in self.actions and self.run_dir:
+            try:
+                path = os.path.join(self.run_dir, "alerts.jsonl")
+                with open(path, "a") as f:
+                    f.write(json.dumps(alert.to_record()) + "\n")
+            except OSError:  # alerting must never kill the watcher
+                log.exception("failed to append alerts.jsonl")
+        if "webhook" in self.actions and self.config.webhook_url:
+            self._post_webhook(alert)
+
+    def _post_webhook(self, alert: Alert) -> None:
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(
+                self.config.webhook_url,
+                data=json.dumps(alert.to_record()).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=3).close()
+        except Exception:  # best-effort by design
+            log.warning("alert webhook POST failed", exc_info=True)
+
+
+def read_alerts(run_dir: str) -> List[dict]:
+    """Parse a run dir's ``alerts.jsonl`` (post-mortem / test path);
+    empty when no alert ever fired. Shares the torn-line/future-schema
+    tolerance of the other JSONL readers."""
+    path = os.path.join(run_dir, "alerts.jsonl")
+    if not os.path.isfile(path):
+        return []
+    from tpu_ddp.telemetry.summarize import read_records
+
+    return read_records([path], schema_version=ALERT_SCHEMA_VERSION,
+                        kind="alerts")

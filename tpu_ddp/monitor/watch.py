@@ -1,0 +1,296 @@
+"""``tpu-ddp watch <run_dir>`` — the live terminal dashboard.
+
+Polls the fleet aggregator on an interval and renders: the run label,
+fleet throughput (steps/sec, optionally MFU vs the roofline prediction
+rebuilt from the run-metadata header), a per-host table (step, steps/s,
+compiled-step p50, data-wait share, heartbeat age, straggler/lost
+flags), the active alerts, and a loss sparkline from the health record.
+The alert engine runs inside the watcher, so watching a run is also
+what *writes* ``alerts.jsonl`` (and fires the log/webhook actions).
+
+``--once --json`` emits one schema-versioned report (snapshot +
+alerts) and exits — the scripting/CI surface ``make monitor-demo``
+gates on; the exit code is 1 when any alert is firing, so a cron probe
+needs no JSON parsing.
+
+Stdlib-only, like every read-back CLI in-tree — EXCEPT ``--roofline``,
+which lazily imports the jax-backed ``analysis/explain.py`` rebuild to
+join measured throughput against the predicted step time; without jax
+(or with a mesh the local backend can't rebuild) it degrades to a note.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from tpu_ddp.monitor.aggregate import FleetAggregator, MonitorConfig
+from tpu_ddp.monitor.alerts import AlertEngine
+
+#: bump on breaking changes to the ``watch --json`` report shape
+WATCH_SCHEMA_VERSION = 1
+
+
+def build_report(aggregator: FleetAggregator, engine: AlertEngine,
+                 now: Optional[float] = None) -> dict:
+    """One poll: snapshot + alert evaluation -> the ``--json`` payload."""
+    snap = aggregator.poll(now)
+    engine.evaluate(snap)
+    return {
+        "schema_version": WATCH_SCHEMA_VERSION,
+        "snapshot": snap.to_json(),
+        "alerts": [a.to_record() for a in engine.active()],
+    }
+
+
+# -- roofline join (optional, jax-backed) ---------------------------------
+
+def roofline_view(run_dir: str) -> Dict[str, object]:
+    """Predicted per-step time + per-device flops for the recorded run,
+    via the analyze rebuild. Any failure (no jax, anonymous trace, mesh
+    too big for the local backend, un-rebuildable program) returns a
+    ``note`` instead — the dashboard must keep rendering."""
+    try:
+        import jax
+
+        from tpu_ddp.analysis.explain import (
+            anatomy_for_run_meta,
+            read_run_meta,
+        )
+        from tpu_ddp.analysis.roofline import chip_spec, roofline
+
+        meta = read_run_meta(run_dir)
+        n_needed = 1
+        for s in (meta.get("mesh") or {}).values():
+            n_needed *= s
+        local = jax.devices()
+        if n_needed > len(local):
+            return {"note": f"run used {n_needed} devices, local backend "
+                            f"has {len(local)} — roofline join skipped"}
+        anatomy = anatomy_for_run_meta(meta, local[:n_needed])
+        rl = roofline(anatomy, None)
+        spec = chip_spec(anatomy.device_kind)
+        return {
+            "predicted_step_s": rl.predicted_step_s,
+            "bound": rl.bound,
+            "chip": rl.chip,
+            "flops_per_step_device": anatomy.flops,
+            "peak_bf16_flops": spec.peak_bf16_flops if spec else None,
+        }
+    except Exception as e:  # degrade, never take the dashboard down
+        return {"note": f"roofline join unavailable: {e}"}
+
+
+def _join_roofline(report: dict, rl: Dict[str, object]) -> None:
+    """Fold measured fleet p50 step time against the prediction into
+    ``report['roofline']`` (fraction achieved + MFU when computable)."""
+    out = dict(rl)
+    step_s = ((report["snapshot"].get("fleet") or {})
+              .get("phase_p50_s") or {}).get("compiled_step")
+    pred = rl.get("predicted_step_s")
+    if step_s and pred:
+        out["measured_step_p50_s"] = step_s
+        out["roofline_fraction"] = pred / step_s
+    flops, peak = rl.get("flops_per_step_device"), rl.get("peak_bf16_flops")
+    if step_s and flops and peak:
+        out["mfu"] = flops / step_s / peak
+    report["roofline"] = out
+
+
+# -- rendering ------------------------------------------------------------
+
+def _fmt_ms(v: Optional[float]) -> str:
+    return f"{1e3 * v:8.1f}" if isinstance(v, (int, float)) else f"{'-':>8}"
+
+
+def _fmt_age(v: Optional[float]) -> str:
+    if not isinstance(v, (int, float)):
+        return "-"
+    return f"{v:.0f}s" if v < 120 else f"{v / 60:.0f}m"
+
+
+def render_report(report: dict) -> str:
+    """The dashboard text: header, fleet line, per-host table, active
+    alerts, loss sparkline. Pure function of the report (tested as
+    such; the live loop just reprints it)."""
+    snap = report["snapshot"]
+    fleet = snap.get("fleet") or {}
+    lines: List[str] = []
+    mesh = ",".join(f"{a}={s}" for a, s in (snap.get("mesh") or {}).items()
+                    if s != 1)
+    label = [f"watch: {snap.get('run_dir')}"]
+    if snap.get("run_id"):
+        label.append(f"run_id={snap['run_id']}")
+    if snap.get("strategy"):
+        label.append(f"strategy={snap['strategy']}")
+    if mesh:
+        label.append(f"mesh={mesh}")
+    lines.append("  ".join(label))
+
+    rate = fleet.get("steps_per_sec")
+    span = (f"steps {fleet.get('step_min')}..{fleet.get('step_max')}"
+            if fleet.get("step_max") is not None else "no steps yet")
+    fleet_bits = [
+        f"fleet: {fleet.get('n_hosts', 0)} host(s)", span,
+        f"{rate:.2f} steps/s" if isinstance(rate, (int, float)) else
+        "steps/s n/a",
+    ]
+    dws = fleet.get("data_wait_share")
+    if isinstance(dws, (int, float)):
+        fleet_bits.append(f"data-wait {dws:.0%}")
+    rl = report.get("roofline") or {}
+    if rl.get("mfu") is not None:
+        fleet_bits.append(f"MFU {rl['mfu']:.1%}")
+    if rl.get("roofline_fraction") is not None:
+        fleet_bits.append(
+            f"roofline {rl['roofline_fraction']:.0%} ({rl.get('bound')})")
+    lines.append("  ".join(fleet_bits))
+    if rl.get("note"):
+        lines.append(f"  note: {rl['note']}")
+    lines.append("")
+
+    header = (f"{'host':>4} {'step':>8} {'steps/s':>8} {'step_ms':>8} "
+              f"{'wait_ms':>8} {'wait%':>6} {'hb_age':>7}  flags")
+    lines += [header, "-" * len(header)]
+    for h in snap.get("hosts", []):
+        p50 = h.get("phase_p50_s") or {}
+        flags = []
+        if h.get("lost"):
+            flags.append("LOST")
+        if h.get("ended"):
+            flags.append("done")  # clean shutdown, not a loss
+        if h.get("straggler"):
+            flags.append("STRAGGLER")
+        health = h.get("health") or {}
+        if health.get("nonfinite_steps"):
+            flags.append(f"nonfinite×{health['nonfinite_steps']}")
+        rate = h.get("steps_per_sec")
+        share = h.get("data_wait_share")
+        lines.append(
+            f"{h.get('host'):>4} "
+            f"{h.get('step') if h.get('step') is not None else '-':>8} "
+            + (f"{rate:>8.2f} " if isinstance(rate, (int, float))
+               else f"{'-':>8} ")
+            + f"{_fmt_ms(p50.get('compiled_step'))} "
+            + f"{_fmt_ms(p50.get('data_wait'))} "
+            + (f"{share:>6.0%} " if isinstance(share, (int, float))
+               else f"{'-':>6} ")
+            + f"{_fmt_age(h.get('heartbeat_age_s')):>7}  "
+            + (",".join(flags) or "ok")
+        )
+
+    alerts = report.get("alerts") or []
+    lines.append("")
+    if alerts:
+        lines.append(f"active alerts ({len(alerts)}):")
+        for a in alerts:
+            scope = f"host {a['host']}" if a.get("host") is not None \
+                else "fleet"
+            lines.append(
+                f"  {a['rule']} [{a['severity']}] {scope}: {a['message']}")
+    else:
+        lines.append("active alerts: none")
+
+    series = snap.get("loss_series") or []
+    if series:
+        from tpu_ddp.health.summarize import sparkline
+
+        lines.append("")
+        lines.append(f"loss   |{sparkline(series)}|")
+    return "\n".join(lines)
+
+
+# -- CLI ------------------------------------------------------------------
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu-ddp watch",
+        description="live fleet monitor over a run dir's per-host "
+                    "telemetry/health/heartbeat files "
+                    "(docs/monitoring.md)",
+    )
+    ap.add_argument("path", help="run dir (the --telemetry-dir of a "
+                                 "running or finished job)")
+    ap.add_argument("--once", action="store_true",
+                    help="one poll, print, exit (exit code 1 when any "
+                         "alert fires — scriptable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the schema-versioned report JSON instead "
+                         "of the dashboard text")
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="poll/refresh period in seconds (live mode)")
+    ap.add_argument("--stale-seconds", type=float, default=60.0,
+                    help="heartbeat age that marks a host lost (FLT001)")
+    ap.add_argument("--straggler-mad", type=float, default=5.0,
+                    help="k in the median + k*MAD straggler threshold")
+    ap.add_argument("--persist-windows", type=int, default=3,
+                    help="consecutive flagged polls before STR001 fires "
+                         "(--once treats this as 1)")
+    ap.add_argument("--data-wait-max", type=float, default=0.5,
+                    help="DWT001 threshold on the data-wait share")
+    ap.add_argument("--checkpoint-overdue", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help=">0: CKP001 fires when the newest checkpoint "
+                         "span is older than this")
+    ap.add_argument("--webhook", default=None, metavar="URL",
+                    help="also POST every alert edge as JSON here")
+    ap.add_argument("--no-alerts-file", action="store_true",
+                    help="do not append alerts.jsonl into the run dir")
+    ap.add_argument("--roofline", action="store_true",
+                    help="join measured throughput against the roofline "
+                         "prediction (imports jax + compiles the "
+                         "recorded program once; off by default)")
+    args = ap.parse_args(list(argv) if argv is not None else None)
+
+    config = MonitorConfig(
+        straggler_mad_threshold=args.straggler_mad,
+        straggler_persist_windows=args.persist_windows,
+        heartbeat_stale_seconds=args.stale_seconds,
+        data_wait_share_max=args.data_wait_max,
+        checkpoint_overdue_seconds=args.checkpoint_overdue,
+        webhook_url=args.webhook,
+    )
+    actions = ["log"] if args.json else []
+    if not args.no_alerts_file:
+        actions.append("file")
+    if args.webhook:
+        actions.append("webhook")
+    try:
+        aggregator = FleetAggregator(args.path, config)
+    except FileNotFoundError as e:
+        print(f"tpu-ddp watch: {e}", file=sys.stderr)
+        return 2
+    engine = AlertEngine(config, run_dir=args.path,
+                         actions=tuple(actions), once=args.once)
+    rl = roofline_view(args.path) if args.roofline else None
+
+    if args.once:
+        report = build_report(aggregator, engine)
+        if rl is not None:
+            _join_roofline(report, rl)
+        print(json.dumps(report, indent=1) if args.json
+              else render_report(report))
+        return 1 if report["alerts"] else 0
+
+    try:
+        while True:
+            report = build_report(aggregator, engine)
+            if rl is not None:
+                _join_roofline(report, rl)
+            if args.json:
+                print(json.dumps(report), flush=True)
+            else:
+                # clear + home, then the dashboard (plain ANSI, no curses)
+                sys.stdout.write("\x1b[2J\x1b[H" + render_report(report)
+                                 + "\n")
+                sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

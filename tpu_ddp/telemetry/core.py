@@ -103,14 +103,18 @@ class Telemetry:
             attrs=attrs,
         ))
 
-    def emit_counters(self, step: Optional[int] = None) -> None:
+    def emit_counters(self, step: Optional[int] = None, *,
+                      name: str = "counters") -> None:
         """Snapshot the registry into the sinks (JSONL record + Chrome "C"
-        series). Call at natural boundaries (epoch end, run end)."""
+        series). Call at natural boundaries (epoch end, run end); the
+        Trainer's mid-epoch cadence passes ``name="counters_snapshot"``
+        so readers can tell a periodic tail from a clean-shutdown
+        snapshot."""
         if not self.enabled:
             return
         snap = self.registry.snapshot()
         self._emit(Event(
-            name="counters",
+            name=name,
             kind=COUNTERS,
             ts_s=self.clock.now(),
             step=self.current_step if step is None else step,
@@ -148,6 +152,10 @@ class Telemetry:
             return
         self._closed = True
         if self.enabled:
+            # clean-shutdown marker: the fleet aggregator uses it to tell
+            # an ENDED host (trace goes quiet because the run finished)
+            # from a LOST one (trace goes quiet because the host died)
+            self.instant("run_end")
             self.emit_counters()
         for sink in self.sinks:
             try:

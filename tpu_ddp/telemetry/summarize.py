@@ -81,14 +81,39 @@ def aggregate_phases(records: Iterable[dict]) -> Dict[str, Histogram]:
 
 
 def last_counters(records: Iterable[dict]) -> Dict[int, dict]:
-    """Final counters snapshot PER HOST ({pid: attrs}): counters are
+    """Newest counters snapshot PER HOST ({pid: attrs}): counters are
     per-process registries, so a multihost run dir has one final snapshot
-    per trace file — showing only one would silently drop the rest."""
+    per trace file — showing only one would silently drop the rest.
+
+    "Newest" rather than "final" deliberately: a killed/preempted run
+    never writes its clean-shutdown snapshot, but the Trainer's periodic
+    ``counters_snapshot`` cadence (``telemetry_snapshot_steps``) leaves
+    a usable tail — the attrs carry ``_step``/``_name`` metadata so the
+    summary can say which kind it is showing."""
     snaps: Dict[int, dict] = {}
     for rec in records:
         if rec.get("type") == "counters" and rec.get("attrs") is not None:
-            snaps[rec.get("pid", 0)] = rec["attrs"]
+            snaps[rec.get("pid", 0)] = {
+                "_step": rec.get("step"),
+                "_name": rec.get("name"),
+                **rec["attrs"],
+            }
     return snaps
+
+
+def per_host_phase_p50(records: Iterable[dict],
+                       phase: str) -> Dict[int, float]:
+    """{pid: p50 seconds} of one phase's span durations — the input of
+    the multihost skew line (``monitor.aggregate.host_skew``)."""
+    by_host: Dict[int, Histogram] = {}
+    for rec in records:
+        if rec.get("type") != SPAN or rec.get("name") != phase:
+            continue
+        dur = rec.get("dur_s")
+        if isinstance(dur, (int, float)):
+            by_host.setdefault(rec.get("pid", 0), Histogram()).record(dur)
+    return {pid: h.percentile(50) for pid, h in by_host.items()
+            if h.count}
 
 
 def run_label(records: Iterable[dict]) -> Optional[str]:
@@ -155,6 +180,19 @@ def summarize(path: str) -> str:
     if label:
         lines.append(label)
     lines += ["", format_phase_table(phases)]
+    # multihost: one skew line per loop phase with >= 2 reporting hosts
+    # — the post-hoc twin of the live monitor's straggler verdict
+    from tpu_ddp.monitor.aggregate import host_skew
+
+    for phase in ("compiled_step", "data_wait"):
+        skew = host_skew(per_host_phase_p50(records, phase))
+        if skew:
+            lines.append(
+                f"per-host skew: {phase} p50 max delta "
+                f"{1e3 * skew['max_delta']:.2f}ms vs fleet median "
+                f"{1e3 * skew['median']:.2f}ms (host {skew['host']} at "
+                f"{1e3 * skew['value']:.2f}ms)"
+            )
     snaps = last_counters(records)
     for pid in sorted(snaps):
         counters = snaps[pid]
@@ -163,9 +201,19 @@ def summarize(path: str) -> str:
         if not flat:
             continue
         lines.append("")
+        # a periodic mid-run snapshot as the newest record means the run
+        # never shut down cleanly (killed/preempted) — say so instead of
+        # presenting a stale tail as final
+        kind = (
+            "final snapshot" if counters.get("_name") != "counters_snapshot"
+            else "last periodic snapshot"
+            + (f" @ step {counters['_step']}"
+               if counters.get("_step") is not None else "")
+            + " — run did not shut down cleanly"
+        )
         label = (
-            "counters/gauges (final snapshot):" if len(snaps) == 1
-            else f"counters/gauges (final snapshot, host {pid}):"
+            f"counters/gauges ({kind}):" if len(snaps) == 1
+            else f"counters/gauges ({kind}, host {pid}):"
         )
         lines.append(label)
         for k in sorted(flat):

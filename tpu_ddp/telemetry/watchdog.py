@@ -35,6 +35,26 @@ from typing import Callable, Optional
 log = logging.getLogger(__name__)
 
 
+def read_heartbeat(path: str) -> Optional[dict]:
+    """Parse one ``heartbeat-p<i>.json`` liveness file; None when the
+    file is absent or torn mid-replace (both mean "no signal", and the
+    fleet aggregator treats them as such — never as a crash)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def heartbeat_age_seconds(record: Optional[dict],
+                          now: Optional[float] = None) -> Optional[float]:
+    """Seconds since a heartbeat record's wall-time stamp (the staleness
+    input of the lost-host verdict); None without a usable record."""
+    if not record or not isinstance(record.get("wall_time"), (int, float)):
+        return None
+    return (time.time() if now is None else now) - record["wall_time"]
+
+
 def all_stack_dump() -> str:
     """Formatted stacks of every live thread (the hang forensic record)."""
     lines = []
@@ -102,6 +122,21 @@ class HangWatchdog:
     @property
     def fired(self) -> bool:
         return self.fire_count > 0
+
+    @property
+    def last_step(self) -> Optional[int]:
+        return self._last_step
+
+    def seconds_since_beat(self) -> float:
+        """Age of the newest ``beat()`` — the freshness the ``/healthz``
+        endpoint and the staleness verdicts are computed from."""
+        return time.monotonic() - self._last_beat
+
+    def is_stale(self) -> bool:
+        """True once the deadline has passed without a beat: the same
+        condition that fires the stack dump, exposed as a predicate so
+        the monitor exporter's ``/healthz`` flips in lockstep with it."""
+        return self.seconds_since_beat() > self.deadline_seconds
 
     def start(self) -> "HangWatchdog":
         self._last_beat = time.monotonic()

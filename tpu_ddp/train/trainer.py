@@ -183,6 +183,27 @@ class TrainConfig:
                                           # step — attribution costs the
                                           # async-dispatch overlap
     telemetry_sinks: str = "jsonl,chrome,summary"  # comma-separated subset
+    telemetry_snapshot_steps: int = 50    # >0: flush a counters snapshot
+                                          # into the JSONL sink every N
+                                          # steps — a killed/preempted run
+                                          # leaves a usable tail for the
+                                          # fleet aggregator and `trace
+                                          # summarize` (0 disables; the
+                                          # epoch-boundary + final
+                                          # snapshots always happen)
+    monitor_port: int = 0                 # >0: per-host HTTP monitor
+                                          # endpoint on this port
+                                          # (/metrics OpenMetrics,
+                                          # /snapshot.json, /healthz);
+                                          # -1 = ephemeral port (written
+                                          # to exporter-p<i>.json in the
+                                          # telemetry dir); 0 = disabled
+                                          # (docs/monitoring.md)
+    monitor_bind: str = "0.0.0.0"         # exporter bind address; the
+                                          # endpoint is UNauthenticated
+                                          # (/snapshot.json serves the
+                                          # config) — bind 127.0.0.1 on
+                                          # untrusted networks
     watchdog_deadline_seconds: float = 0.0  # >0: hang watchdog — stack
                                           # dump + heartbeat staleness when
                                           # no step completes in time
@@ -243,6 +264,16 @@ class TrainConfig:
             raise ValueError(
                 "health_per_layer_stride must be >= 0, got "
                 f"{self.health_per_layer_stride}"
+            )
+        if self.telemetry_snapshot_steps < 0:
+            raise ValueError(
+                "telemetry_snapshot_steps must be >= 0, got "
+                f"{self.telemetry_snapshot_steps}"
+            )
+        if self.monitor_port < -1 or self.monitor_port > 65535:
+            raise ValueError(
+                f"monitor_port must be -1 (ephemeral), 0 (disabled), or "
+                f"a TCP port, got {self.monitor_port}"
             )
         if self.health_window < 4:
             raise ValueError(
@@ -424,9 +455,21 @@ class Trainer:
         # and refuse mismatched ones — run dirs used to be anonymous.
         from tpu_ddp.telemetry import RUN_META_SCHEMA_VERSION, build_telemetry
 
+        # run_id: a short stable config digest — deterministic, so every
+        # host of a multihost run derives the SAME id without a
+        # coordination round, and the monitor exporter's /metrics labels
+        # line up across the fleet scrape
+        import hashlib
+
+        config_snapshot = dataclasses.asdict(config)
+        run_id = hashlib.sha1(
+            json.dumps(config_snapshot, sort_keys=True,
+                       default=str).encode()
+        ).hexdigest()[:10]
         self.run_meta = {
             "run_meta_schema_version": RUN_META_SCHEMA_VERSION,
-            "config": dataclasses.asdict(config),
+            "run_id": run_id,
+            "config": config_snapshot,
             "jax_version": jax.__version__,
             "device_kind": devices[0].device_kind,
             "strategy": self.parallelism,
@@ -442,6 +485,7 @@ class Trainer:
             run_meta=self.run_meta,
         )
         self._watchdog = None
+        self._exporter = None   # monitor HTTP endpoint (started in run())
         # Numerics flight recorder (docs/health.md): the in-graph half is
         # compiled into the step builders below (health=self._health);
         # this monitor is the host half — JSONL record, spike detection,
@@ -1147,11 +1191,15 @@ class Trainer:
 
     def _release_workers(self) -> None:
         """Stop the host-side helpers: prefetcher (worker thread + slot
-        buffers), watchdog, and the health monitor (flushes its JSONL
-        footer). Idempotent; does NOT close the telemetry sinks."""
+        buffers), monitor exporter, watchdog, and the health monitor
+        (flushes its JSONL footer). Idempotent; does NOT close the
+        telemetry sinks."""
         if self._prefetcher is not None:
             self._prefetcher.close()
             self._prefetcher = None
+        if self._exporter is not None:
+            self._exporter.close()
+            self._exporter = None
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
@@ -1349,6 +1397,33 @@ class Trainer:
                 process_index=self.process_index,
                 telemetry=tel,
             ).start()
+        if c.monitor_port:
+            # Per-host live scrape endpoint (docs/monitoring.md). A bind
+            # failure (port taken) degrades to a warning: observability
+            # must never take down the training it observes.
+            from tpu_ddp.monitor.exporter import MonitorExporter
+
+            try:
+                self._exporter = MonitorExporter(
+                    registry=tel.registry,
+                    run_meta=self.run_meta,
+                    port=c.monitor_port if c.monitor_port > 0 else 0,
+                    host=c.monitor_bind,
+                    process_index=self.process_index,
+                    watchdog_provider=lambda: self._watchdog,
+                    run_dir=c.telemetry_dir,
+                ).start()
+                log.info(
+                    "monitor exporter on port %d "
+                    "(/metrics /snapshot.json /healthz)",
+                    self._exporter.port,
+                )
+            except OSError as e:
+                log.warning(
+                    "monitor exporter failed to bind port %s: %s "
+                    "(continuing without the live endpoint)",
+                    c.monitor_port, e,
+                )
         last_metrics = {}
         # Steady-state step time: measured per epoch between REAL sync points
         # (the device_get below), excluding the first epoch (XLA compile).
@@ -1446,6 +1521,16 @@ class Trainer:
                     dn = self.steps_per_call if kind == "stacked" else 1
                     tel.count("train/steps", dn)
                     tel.count("train/images", n_real)
+                    # Periodic counters snapshot: a killed/preempted run
+                    # must leave a usable tail for the fleet aggregator
+                    # and `trace summarize` — the epoch-boundary snapshot
+                    # alone can be a whole epoch stale when the SIGKILL
+                    # lands (docs/monitoring.md)
+                    snap_every = c.telemetry_snapshot_steps
+                    if snap_every and (host_step // snap_every) > (
+                        (host_step - dn) // snap_every
+                    ):
+                        tel.emit_counters(name="counters_snapshot")
                 if self._watchdog is not None:
                     # without tracing the dispatch is async: the beat then
                     # means "the host is still submitting work", which
