@@ -5,7 +5,7 @@ PYTHON ?= python
 
 .PHONY: test test-all dryrun bench smoke capture aot real-data lint \
 	trace-demo health-demo zero-demo compress-demo analyze-demo \
-	lint-demo monitor-demo bench-compare
+	lint-demo monitor-demo profile-demo bench-compare
 
 # Fast default loop (round-3 verdict item 5): skips the `slow`-marked
 # multi-process / end-to-end-CLI / AOT tests. CI and pre-commit should run
@@ -144,6 +144,20 @@ monitor-demo:
 	rm -rf $(MONITOR_DEMO_DIR)
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
 	  $(PYTHON) -m tpu_ddp.tools.monitor_demo --dir $(MONITOR_DEMO_DIR)
+
+# Anomaly-profiler acceptance (docs/profiling.md): a 4-device CPU run
+# with an injected slow input pipeline — DWT001 must fire in a watch-side
+# alert engine, the capture_profile action must auto-arm a capture over
+# POST /profile, the bundle's host top stacks must contain the injected
+# stall frame, and `tpu-ddp profile` must render it plus the per-op
+# attribution table (deviceless anatomy join; jax.profiler absence
+# degrades to a note). Exits nonzero on any miss
+# (tpu_ddp/tools/profile_demo.py).
+PROFILE_DEMO_DIR ?= /tmp/tpu_ddp_profile_demo
+profile-demo:
+	rm -rf $(PROFILE_DEMO_DIR)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+	  $(PYTHON) -m tpu_ddp.tools.profile_demo --dir $(PROFILE_DEMO_DIR)
 
 # Deviceless perf-regression gate: re-capture the AOT artifact with the
 # real XLA:TPU toolchain (needs libtpu; ~30+ min of compiles) and diff

@@ -16,6 +16,10 @@ Subcommands:
   stragglers and lost hosts, and runs the alert rules
   (``alerts.jsonl``); ``--once --json`` for scripting and CI
   (docs/monitoring.md).
+- ``tpu-ddp profile <run_dir>`` — render anomaly-profiler capture
+  bundles (``<run_dir>/profiles/``): trigger/alert provenance, host
+  top stacks (folded-stack sampler), measured-vs-predicted per-op
+  attribution, and the cross-host straggler diff (docs/profiling.md).
 - ``tpu-ddp analyze [run_dir]`` — static step-time anatomy: XLA
   cost-model flops/bytes, collective inventory, roofline bound
   classification, per-strategy collective fingerprint; given a run dir,
@@ -33,8 +37,8 @@ Subcommands:
   collectives, widened payload dtypes, memory/flops growth, new lint
   findings).
 
-``trace summarize``, ``health``, ``watch``, and ``bench compare`` are
-stdlib-only
+``trace summarize``, ``health``, ``watch``, ``profile`` (modulo its
+lazy per-op join), and ``bench compare`` are stdlib-only
 end to end (no jax import): records are summarized wherever they land —
 a laptop, a CI box, the pod host itself. The train/launch/analyze
 subcommands import lazily so the read-back commands keep that property.
@@ -98,6 +102,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_ddp.monitor.watch import main as watch_main
 
         return watch_main(argv[1:])
+    # profile is stdlib-only too, except the per-op attribution join
+    # (lazy jax; --no-ops keeps it import-free)
+    if argv[:1] == ["profile"]:
+        from tpu_ddp.profiler.report import main as profile_main
+
+        return profile_main(argv[1:])
     if argv[:2] == ["bench", "compare"]:
         from tpu_ddp.analysis.regress import main as compare_main
 
@@ -133,6 +143,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="live fleet monitor over a run dir: per-host steps/sec + "
              "phase p50s, straggler/lost-host flags, alert rules "
              "(tpu-ddp watch --help)",
+    )
+    sub.add_parser(
+        "profile",
+        help="render anomaly-profiler capture bundles: host top stacks, "
+             "per-op attribution, straggler diff "
+             "(tpu-ddp profile --help)",
     )
     sub.add_parser(
         "analyze",

@@ -191,6 +191,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", default=None,
                    help="emit an XLA/TPU profiler trace (TensorBoard/"
                         "Perfetto) for one steady-state epoch")
+    p.add_argument("--profile-steps", default=None, metavar="A:B",
+                   help="arm an anomaly-profiler capture window over "
+                        "global steps (A, B]: host stack sampling + "
+                        "device trace + measured phases, bundled under "
+                        "<telemetry-dir>/profiles/ and read back with "
+                        "`tpu-ddp profile` (docs/profiling.md). Windows "
+                        "can also be armed on a LIVE run: POST "
+                        "/profile?steps=N to --monitor-port, or the "
+                        "capture_profile alert action on `tpu-ddp watch`")
+    p.add_argument("--profile-window-steps", type=int, default=8,
+                   metavar="N",
+                   help="window length (steps) for live-triggered "
+                        "captures (POST /profile or alert-armed)")
+    p.add_argument("--profile-host-hz", type=float, default=97.0,
+                   metavar="HZ",
+                   help="host stack sampler rate inside a capture window")
     p.add_argument("--telemetry-dir", default=None, metavar="DIR",
                    help="enable structured telemetry into this run dir: "
                         "per-host schema-versioned JSONL trace + Chrome "
@@ -221,6 +237,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "UNauthenticated and /snapshot.json serves the "
                         "run config — bind 127.0.0.1 (and scrape via a "
                         "tunnel) on untrusted networks")
+    p.add_argument("--monitor-allow-remote-trigger", action="store_true",
+                   help="accept POST /profile from non-loopback peers "
+                        "(default: loopback-only — the endpoint is "
+                        "unauthenticated, and this route mutates run "
+                        "behavior; see docs/monitoring.md's security "
+                        "note before opening it up)")
     p.add_argument("--watchdog-deadline", type=float, default=0.0,
                    metavar="SECONDS",
                    help=">0: hang watchdog — every host writes a "
@@ -420,11 +442,15 @@ def config_from_args(args) -> TrainConfig:
         jsonl_path=args.jsonl,
         tensorboard_dir=args.tensorboard_dir,
         profile_dir=args.profile_dir,
+        profile_steps=args.profile_steps,
+        profile_window_steps=args.profile_window_steps,
+        profile_host_hz=args.profile_host_hz,
         telemetry_dir=args.telemetry_dir,
         telemetry_sinks=args.telemetry_sinks,
         telemetry_snapshot_steps=args.telemetry_snapshot_steps,
         monitor_port=args.monitor_port,
         monitor_bind=args.monitor_bind,
+        monitor_allow_remote_trigger=args.monitor_allow_remote_trigger,
         watchdog_deadline_seconds=args.watchdog_deadline,
         health=args.health,
         health_policy=args.health_policy,

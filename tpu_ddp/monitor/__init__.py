@@ -41,8 +41,10 @@ from tpu_ddp.monitor.aggregate import (
 from tpu_ddp.monitor.alerts import (
     ALERT_RULES,
     ALERT_SCHEMA_VERSION,
+    CAPTURE_PROFILE_RULES,
     Alert,
     AlertEngine,
+    alert_history,
 )
 from tpu_ddp.monitor.exporter import MonitorExporter, render_openmetrics
 
@@ -50,8 +52,10 @@ __all__ = [
     "SNAPSHOT_SCHEMA_VERSION",
     "ALERT_SCHEMA_VERSION",
     "ALERT_RULES",
+    "CAPTURE_PROFILE_RULES",
     "Alert",
     "AlertEngine",
+    "alert_history",
     "FleetAggregator",
     "FleetSnapshot",
     "HostSnapshot",

@@ -77,6 +77,9 @@ class MonitorConfig:
     grad_norm_mad_threshold: float = 10.0  # NUM001: k over the norm window
     checkpoint_overdue_seconds: float = 0.0  # CKP001 (0 = rule disabled)
     webhook_url: Optional[str] = None      # alert webhook action target
+    max_auto_profiles: int = 3             # capture_profile action: alert-
+                                           # armed profiler captures per run
+                                           # (edge-triggered; 0 disables)
 
     def validate(self) -> "MonitorConfig":
         if self.window < 8:
@@ -87,6 +90,10 @@ class MonitorConfig:
             raise ValueError("heartbeat_stale_seconds must be > 0")
         if self.straggler_persist_windows < 1:
             raise ValueError("straggler_persist_windows must be >= 1")
+        if self.max_auto_profiles < 0:
+            raise ValueError(
+                f"max_auto_profiles must be >= 0, got "
+                f"{self.max_auto_profiles}")
         return self
 
 

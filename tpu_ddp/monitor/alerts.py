@@ -15,8 +15,13 @@ emits one RESOLVED record when it clears — a flapping fleet produces a
 readable alert log, not one line per poll. Every edge goes through the
 configured actions: ``log`` (process logger), ``file``
 (schema-versioned ``alerts.jsonl`` appended in the run dir — the
-durable record the post-mortem reads), and ``webhook`` (JSON POST to
-``MonitorConfig.webhook_url``, best-effort). Stdlib-only.
+durable record the post-mortem reads), ``webhook`` (JSON POST to
+``MonitorConfig.webhook_url``, best-effort), and ``capture_profile``
+(a performance alert's firing edge POSTs ``/profile`` at the implicated
+host's exporter, so the anomaly profiler captures a window WHILE the
+anomaly is live — rate-limited to ``MonitorConfig.max_auto_profiles``
+per run, and edge-triggered like the alerts themselves: a persisting
+condition arms one capture, not one per poll). Stdlib-only.
 """
 
 from __future__ import annotations
@@ -35,6 +40,13 @@ log = logging.getLogger(__name__)
 
 #: bump on any breaking change to the alerts.jsonl record shape
 ALERT_SCHEMA_VERSION = 1
+
+#: the performance rules whose firing edge auto-arms a profiler capture
+#: under the ``capture_profile`` action: a straggler, a throughput
+#: collapse, and an input-bound loop are exactly the anomalies a capture
+#: window can explain. Numerics alerts (NUM*) already have their own
+#: evidence path (the health anomaly dump), and FLT001's host is gone.
+CAPTURE_PROFILE_RULES = ("STR001", "THR001", "DWT001")
 
 #: rule registry: id -> (what it catches, severity, kind, fix hint) —
 #: the single source behind findings and the docs/monitoring.md table
@@ -141,11 +153,16 @@ class AlertEngine:
         run_dir: Optional[str] = None,
         actions: Tuple[str, ...] = ("log", "file"),
         once: bool = False,
+        profile_trigger=None,
     ):
         self.config = config or MonitorConfig()
         self.run_dir = run_dir
         self.actions = tuple(actions)
         self.once = once
+        # the capture_profile action's POST; injectable for tests. The
+        # default discovers the run's exporter endpoints from the run dir
+        self._profile_trigger = profile_trigger
+        self.auto_profiles = 0      # successful capture arms this run
         self._active: Dict[Tuple[str, Optional[int]], Alert] = {}
         self._straggler_runs: Dict[int, int] = {}
         self._rate_baseline: deque = deque(
@@ -330,6 +347,46 @@ class AlertEngine:
                 log.exception("failed to append alerts.jsonl")
         if "webhook" in self.actions and self.config.webhook_url:
             self._post_webhook(alert)
+        if ("capture_profile" in self.actions
+                and alert.state == "firing"
+                and alert.rule in CAPTURE_PROFILE_RULES):
+            self._capture_profile(alert)
+
+    def _capture_profile(self, alert: Alert) -> None:
+        """Arm an anomaly-profiler capture off a performance alert's
+        firing edge. Host-scoped alerts (STR001/DWT001) target the
+        implicated host's exporter; fleet-scoped ones (THR001) arm every
+        host. Edge-triggering already bounds this to one attempt per
+        alert episode; ``max_auto_profiles`` bounds the run total."""
+        if self.auto_profiles >= self.config.max_auto_profiles:
+            log.info(
+                "alert %s fired but max_auto_profiles (%d) is exhausted; "
+                "arm manually with POST /profile if needed",
+                alert.rule, self.config.max_auto_profiles,
+            )
+            return
+        trigger = self._profile_trigger
+        if trigger is None:
+            if not self.run_dir:
+                return
+            from tpu_ddp.profiler.capture import post_profile_trigger
+
+            def trigger(**kw):
+                return post_profile_trigger(self.run_dir, **kw)
+
+        try:
+            armed = trigger(host=alert.host, rule=alert.rule, steps=None)
+        except Exception:
+            log.warning("capture_profile trigger failed", exc_info=True)
+            return
+        if armed:
+            self.auto_profiles += 1
+            log.warning(
+                "alert %s auto-armed a profiler capture (%d/%d this "
+                "run); read it back with `tpu-ddp profile %s`",
+                alert.rule, self.auto_profiles,
+                self.config.max_auto_profiles, self.run_dir or "<run_dir>",
+            )
 
     def _post_webhook(self, alert: Alert) -> None:
         import urllib.request
@@ -356,3 +413,42 @@ def read_alerts(run_dir: str) -> List[dict]:
 
     return read_records([path], schema_version=ALERT_SCHEMA_VERSION,
                         kind="alerts")
+
+
+def alert_history(records: List[dict]) -> List[dict]:
+    """Pair ``alerts.jsonl`` firing/resolved edges into EPISODES — what
+    ``tpu-ddp watch`` renders as history: each entry carries the rule,
+    scope, firing message, and (once resolved) the episode duration.
+    Unresolved episodes come back with ``resolved_wall=None`` (still
+    active, or the watcher died first); edges are paired per
+    (rule, host) in file order, so interleaved episodes of different
+    scopes can't cross-match."""
+    open_eps: Dict[Tuple[str, Optional[int]], dict] = {}
+    episodes: List[dict] = []
+    for rec in records:
+        if rec.get("type") != "alert":
+            continue
+        key = (rec.get("rule"), rec.get("host"))
+        if rec.get("state") == "firing":
+            ep = {
+                "rule": rec.get("rule"),
+                "severity": rec.get("severity"),
+                "host": rec.get("host"),
+                "message": rec.get("message"),
+                "step": rec.get("step"),
+                "fired_wall": rec.get("wall_time"),
+                "resolved_wall": None,
+                "duration_s": None,
+            }
+            open_eps[key] = ep
+            episodes.append(ep)
+        elif rec.get("state") == "resolved":
+            ep = open_eps.pop(key, None)
+            if ep is None:
+                continue  # resolved without a recorded firing (torn file)
+            ep["resolved_wall"] = rec.get("wall_time")
+            fired, resolved = ep["fired_wall"], ep["resolved_wall"]
+            if isinstance(fired, (int, float)) and isinstance(
+                    resolved, (int, float)):
+                ep["duration_s"] = max(resolved - fired, 0.0)
+    return episodes

@@ -17,6 +17,14 @@ live instead of waiting for the post-hoc JSONL summaries:
   while beats are fresh, 503 once the stall deadline passes — the
   same staleness contract the watchdog's stack-dump fires on.
 
+One write route: ``POST /profile?steps=N`` arms an anomaly-profiler
+capture window on the live run (``profiler/capture.py``) — how an
+operator, the watch process, or the ``capture_profile`` alert action
+profiles a run that is ALREADY slow, without a restart. Because it
+mutates run behavior on an unauthenticated endpoint, it is
+**loopback-only** unless ``--monitor-allow-remote-trigger`` opted in
+(docs/monitoring.md security note).
+
 Stdlib-only (``http.server`` on a daemon thread) and jax-free: the
 endpoint must keep answering precisely when the jax runtime is the
 thing that hung. Serving never blocks training — handlers read the
@@ -129,6 +137,12 @@ class MonitorExporter:
     ``watchdog_provider`` is a callable returning the live HangWatchdog
     (or None): the Trainer builds the watchdog after the exporter, so
     the binding must be late.
+
+    ``profile_trigger`` is the capture-arming callable (the Trainer
+    passes ``CaptureManager.request``); None means the run has no
+    capture manager and ``POST /profile`` answers 503.
+    ``allow_remote_trigger`` lifts the loopback-only restriction on
+    that route (``--monitor-allow-remote-trigger``).
     """
 
     def __init__(
@@ -142,6 +156,8 @@ class MonitorExporter:
         watchdog=None,
         watchdog_provider: Optional[Callable[[], object]] = None,
         run_dir: Optional[str] = None,
+        profile_trigger: Optional[Callable[..., bool]] = None,
+        allow_remote_trigger: bool = False,
     ):
         if registry is None:
             from tpu_ddp.telemetry.registry import default_registry
@@ -151,6 +167,8 @@ class MonitorExporter:
         self.run_meta = run_meta or {}
         self.process_index = process_index
         self.run_dir = run_dir
+        self.profile_trigger = profile_trigger
+        self.allow_remote_trigger = allow_remote_trigger
         self._watchdog_provider = (
             watchdog_provider if watchdog_provider is not None
             else (lambda: watchdog)
@@ -198,6 +216,59 @@ class MonitorExporter:
     def metrics_text(self) -> str:
         return render_openmetrics(self.registry.snapshot(), self._labels)
 
+    def arm_profile(self, query: str, client_ip: str):
+        """The ``POST /profile`` verdict: ``(status_code, body_dict)``.
+        Factored off the handler so the origin gate and parameter
+        parsing are unit-testable without a socket."""
+        from tpu_ddp.profiler.capture import _is_loopback
+
+        if not self.allow_remote_trigger and not _is_loopback(client_ip):
+            return 403, {
+                "error": "remote profile trigger refused: the endpoint "
+                         "is unauthenticated — POST from loopback, or "
+                         "start the run with "
+                         "--monitor-allow-remote-trigger",
+            }
+        if self.profile_trigger is None:
+            return 503, {
+                "error": "no capture manager on this run (profiling "
+                         "needs --telemetry-dir for the bundle dir)",
+            }
+        import urllib.parse
+
+        params = urllib.parse.parse_qs(query)
+
+        def one(key):
+            vals = params.get(key)
+            return vals[0] if vals else None
+
+        steps = one("steps")
+        if steps is not None:
+            try:
+                steps = int(steps)
+                if steps < 1:
+                    raise ValueError
+            except ValueError:
+                return 400, {"error": f"bad steps value {one('steps')!r}"}
+        alert_host = one("host")
+        try:
+            alert_host = int(alert_host) if alert_host is not None else None
+        except ValueError:
+            return 400, {"error": f"bad host value {one('host')!r}"}
+        armed = self.profile_trigger(
+            steps=steps,
+            source=one("source") or "http",
+            rule=one("rule"),
+            host=alert_host,
+        )
+        if not armed:
+            return 429, {
+                "armed": False,
+                "error": "capture refused: a window is already armed/"
+                         "active, or this run hit its capture limit",
+            }
+        return 200, {"armed": True, "steps": steps}
+
     # -- http plumbing ----------------------------------------------------
 
     def _handler(self):
@@ -240,6 +311,32 @@ class MonitorExporter:
                     # a broken scrape must never propagate into training,
                     # but the scraper deserves a status, not an empty reply
                     log.exception("monitor exporter request failed")
+                    try:
+                        self._send(
+                            500,
+                            json.dumps({"error": str(e)}).encode(),
+                            "application/json",
+                        )
+                    except Exception:
+                        pass  # headers already sent / socket gone
+
+            def do_POST(self):  # noqa: N802 (http.server API)
+                try:
+                    # drain any request body so the socket stays clean
+                    length = int(self.headers.get("Content-Length") or 0)
+                    if length:
+                        self.rfile.read(length)
+                    path, _, query = self.path.partition("?")
+                    if path != "/profile":
+                        self._send(404, b'{"error": "not found"}\n',
+                                   "application/json")
+                        return
+                    code, body = exporter.arm_profile(
+                        query, self.client_address[0])
+                    self._send(code, json.dumps(body).encode(),
+                               "application/json")
+                except Exception as e:
+                    log.exception("monitor exporter POST failed")
                     try:
                         self._send(
                             500,

@@ -22,27 +22,75 @@ join measured throughput against the predicted step time; without jax
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
 import time
 from typing import Dict, List, Optional, Sequence
 
 from tpu_ddp.monitor.aggregate import FleetAggregator, MonitorConfig
-from tpu_ddp.monitor.alerts import AlertEngine
+from tpu_ddp.monitor.alerts import AlertEngine, alert_history, read_alerts
 
 #: bump on breaking changes to the ``watch --json`` report shape
-WATCH_SCHEMA_VERSION = 1
+#: (v2: + ``history`` — resolved alert episodes from alerts.jsonl — and
+#: ``profiles`` — the run's profiler capture-bundle inventory)
+WATCH_SCHEMA_VERSION = 2
+
+
+class _RunRecords:
+    """Cached view of a run dir's DURABLE records — ``alerts.jsonl``
+    episodes and the profiler capture inventory. The live watch loop
+    polls every few seconds forever, and the alert log only grows:
+    re-parsing it end-to-end per tick would be O(file) work per poll,
+    so the parse re-runs only when the underlying files change (alert
+    log size, bundle meta set)."""
+
+    def __init__(self, run_dir: str):
+        self.run_dir = run_dir
+        self._signature = None
+        self._history: List[dict] = []
+        self._profiles: List[dict] = []
+
+    def read(self):
+        try:
+            alerts_size = os.path.getsize(
+                os.path.join(self.run_dir, "alerts.jsonl"))
+        except OSError:
+            alerts_size = -1
+        metas = tuple(sorted(glob.glob(
+            os.path.join(self.run_dir, "profiles", "*", "meta.json"))))
+        signature = (alerts_size, metas)
+        if signature != self._signature:
+            from tpu_ddp.profiler.capture import list_bundles
+
+            self._history = alert_history(read_alerts(self.run_dir))
+            self._profiles = list_bundles(self.run_dir)
+            self._signature = signature
+        return self._history, self._profiles
 
 
 def build_report(aggregator: FleetAggregator, engine: AlertEngine,
-                 now: Optional[float] = None) -> dict:
-    """One poll: snapshot + alert evaluation -> the ``--json`` payload."""
+                 now: Optional[float] = None,
+                 records: Optional[_RunRecords] = None) -> dict:
+    """One poll: snapshot + alert evaluation -> the ``--json`` payload.
+    Alongside the live snapshot/alerts, the report folds in the run's
+    durable records: the alert HISTORY (every fired episode in
+    ``alerts.jsonl``, with durations once resolved — so ``--once`` over
+    a finished run shows what happened, not just what is happening) and
+    the profiler capture inventory (``profiles/*/``). Pass a
+    ``_RunRecords`` to amortize that parse across a live loop's polls."""
     snap = aggregator.poll(now)
     engine.evaluate(snap)
+    if records is None:
+        records = _RunRecords(aggregator.run_dir)
+    history, profiles = records.read()
     return {
         "schema_version": WATCH_SCHEMA_VERSION,
         "snapshot": snap.to_json(),
         "alerts": [a.to_record() for a in engine.active()],
+        "history": history,
+        "profiles": profiles,
     }
 
 
@@ -194,6 +242,39 @@ def render_report(report: dict) -> str:
     else:
         lines.append("active alerts: none")
 
+    # resolved episodes from alerts.jsonl — the durable record, so a
+    # watcher attached AFTER an incident still sees what happened
+    history = [ep for ep in (report.get("history") or [])
+               if ep.get("resolved_wall") is not None]
+    if history:
+        lines.append(f"alert history ({len(history)} resolved "
+                     "episode(s), newest last):")
+        for ep in history[-8:]:
+            scope = (f"host {ep['host']}" if ep.get("host") is not None
+                     else "fleet")
+            dur = ep.get("duration_s")
+            lines.append(
+                f"  {ep['rule']} [{ep.get('severity')}] {scope}: "
+                f"resolved after "
+                + (_fmt_age(dur) if isinstance(dur, (int, float))
+                   else "?")
+                + (f" @ step {ep['step']}"
+                   if ep.get("step") is not None else "")
+            )
+
+    profiles = report.get("profiles") or []
+    if profiles:
+        latest = profiles[-1]
+        trig = latest.get("trigger") or "?"
+        if latest.get("rule"):
+            trig = f"alert:{latest['rule']}"
+        lines.append(
+            f"profile captures: {len(profiles)} bundle(s) — latest "
+            f"steps {latest.get('start_step')}..{latest.get('end_step')} "
+            f"(trigger {trig}); read with `tpu-ddp profile "
+            f"{snap.get('run_dir')}`"
+        )
+
     series = snap.get("loss_series") or []
     if series:
         from tpu_ddp.health.summarize import sparkline
@@ -239,6 +320,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="also POST every alert edge as JSON here")
     ap.add_argument("--no-alerts-file", action="store_true",
                     help="do not append alerts.jsonl into the run dir")
+    ap.add_argument("--capture-profile", action="store_true",
+                    help="alert action: a STR001/THR001/DWT001 firing "
+                         "edge POSTs /profile at the implicated host's "
+                         "monitor endpoint, auto-arming an anomaly-"
+                         "profiler capture (docs/profiling.md); "
+                         "rate-limited by --max-auto-profiles")
+    ap.add_argument("--max-auto-profiles", type=int, default=3,
+                    metavar="N",
+                    help="alert-armed profiler captures allowed per "
+                         "watch session (0 disables the arming while "
+                         "keeping --capture-profile accepted)")
     ap.add_argument("--roofline", action="store_true",
                     help="join measured throughput against the roofline "
                          "prediction (imports jax + compiles the "
@@ -252,12 +344,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         data_wait_share_max=args.data_wait_max,
         checkpoint_overdue_seconds=args.checkpoint_overdue,
         webhook_url=args.webhook,
+        max_auto_profiles=args.max_auto_profiles,
     )
     actions = ["log"] if args.json else []
     if not args.no_alerts_file:
         actions.append("file")
     if args.webhook:
         actions.append("webhook")
+    if args.capture_profile:
+        actions.append("capture_profile")
     try:
         aggregator = FleetAggregator(args.path, config)
     except FileNotFoundError as e:
@@ -275,9 +370,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               else render_report(report))
         return 1 if report["alerts"] else 0
 
+    records = _RunRecords(args.path)
     try:
         while True:
-            report = build_report(aggregator, engine)
+            report = build_report(aggregator, engine, records=records)
             if rl is not None:
                 _join_roofline(report, rl)
             if args.json:

@@ -55,6 +55,11 @@ class Telemetry:
         self.clock = clock or Clock()
         self.current_step: Optional[int] = None
         self._closed = False
+        # high-rate window taps (the anomaly profiler's capture manager):
+        # each listener sees every span's (name, dur_s) as it closes —
+        # how a capture window measures its own per-phase times without
+        # re-reading the JSONL it is being written into
+        self._span_listeners: list = []
 
     # -- spans / events ---------------------------------------------------
 
@@ -87,6 +92,11 @@ class Telemetry:
                 attrs=attrs,
             ))
             self.registry.histogram(f"phase/{name}").record(dur)
+            for listener in self._span_listeners:
+                try:
+                    listener(name, dur)
+                except Exception:  # a broken tap must never kill training
+                    pass
 
     def instant(self, name: str, step: Optional[int] = None,
                 **attrs) -> None:
@@ -144,6 +154,21 @@ class Telemetry:
     def count(self, name: str, n: float = 1) -> None:
         if self.enabled:
             self.registry.counter(name).inc(n)
+
+    # -- span listeners (capture windows) ---------------------------------
+
+    def add_span_listener(self, listener) -> None:
+        """Register a ``(name, dur_s)`` callback fired as each span
+        closes — the profiler's capture window taps the live stream for
+        its measured-phase record. No-op stream when disabled (spans
+        never fire)."""
+        self._span_listeners.append(listener)
+
+    def remove_span_listener(self, listener) -> None:
+        try:
+            self._span_listeners.remove(listener)
+        except ValueError:
+            pass
 
     # -- lifecycle --------------------------------------------------------
 

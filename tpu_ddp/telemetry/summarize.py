@@ -168,6 +168,22 @@ def format_comms(counters: dict) -> List[str]:
     return lines
 
 
+def format_profiler(counters: dict) -> List[str]:
+    """The anomaly-profiler section: how many capture windows ran and
+    how much wall time sat inside them, from the ``profiler/*`` counters
+    the capture manager bumps per bundle (docs/profiling.md). Empty when
+    the run never captured."""
+    n = counters.get("profiler/captures_total")
+    if not n:
+        return []
+    secs = counters.get("profiler/capture_seconds")
+    line = f"profiler: {int(n)} capture window(s)"
+    if isinstance(secs, (int, float)):
+        line += f", {secs:.2f}s inside windows"
+    line += " — bundles under <run_dir>/profiles/ (tpu-ddp profile)"
+    return [line]
+
+
 def summarize(path: str) -> str:
     """Human-readable summary of a run dir / trace file."""
     files = find_trace_files(path)
@@ -224,4 +240,8 @@ def summarize(path: str) -> str:
         if comms:
             lines.append("")
             lines.extend(comms)
+        profiler = format_profiler(flat)
+        if profiler:
+            lines.append("")
+            lines.extend(profiler)
     return "\n".join(lines)

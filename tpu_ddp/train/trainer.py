@@ -168,6 +168,26 @@ class TrainConfig:
     profile_dir: Optional[str] = None     # emit an XLA/TPU trace (Tensor-
                                           # Board/Perfetto) for ONE steady-
                                           # state epoch (SURVEY.md §5.1)
+    profile_steps: Optional[str] = None   # "A:B": arm an anomaly-profiler
+                                          # capture window over global steps
+                                          # (A, B] — host stack sampling +
+                                          # device trace + measured phases
+                                          # bundled under
+                                          # <telemetry_dir>/profiles/
+                                          # (docs/profiling.md). Windows can
+                                          # also be armed live (POST
+                                          # /profile on --monitor-port) or
+                                          # by the capture_profile alert
+                                          # action; requires telemetry_dir
+    profile_window_steps: int = 8         # default window length (steps)
+                                          # for live-triggered captures
+    profile_host_hz: float = 97.0         # host stack sampler rate inside
+                                          # a capture window
+    monitor_allow_remote_trigger: bool = False  # lift the loopback-only
+                                          # restriction on POST /profile
+                                          # (the endpoint is UNauthenti-
+                                          # cated — see docs/monitoring.md
+                                          # before opening this up)
     compilation_cache_dir: Optional[str] = None  # persistent XLA compile
                                           # cache (jax_compilation_cache_dir,
                                           # applied before the first trace):
@@ -274,6 +294,24 @@ class TrainConfig:
             raise ValueError(
                 f"monitor_port must be -1 (ephemeral), 0 (disabled), or "
                 f"a TCP port, got {self.monitor_port}"
+            )
+        from tpu_ddp.profiler.capture import parse_profile_steps
+
+        # raises on a malformed window spec — at parse time, not step A
+        parse_profile_steps(self.profile_steps)
+        if self.profile_steps and not self.telemetry_dir:
+            raise ValueError(
+                "--profile-steps needs --telemetry-dir: the capture "
+                "bundle is written under <telemetry_dir>/profiles/"
+            )
+        if self.profile_window_steps < 1:
+            raise ValueError(
+                "profile_window_steps must be >= 1, got "
+                f"{self.profile_window_steps}"
+            )
+        if self.profile_host_hz <= 0:
+            raise ValueError(
+                f"profile_host_hz must be > 0, got {self.profile_host_hz}"
             )
         if self.health_window < 4:
             raise ValueError(
@@ -524,6 +562,29 @@ class Trainer:
             # satellite fix: create the profiler dir up front — a typo'd
             # path fails NOW, not after an epoch of training
             os.makedirs(config.profile_dir, exist_ok=True)
+        # Anomaly profiler (docs/profiling.md): the capture manager sits
+        # dormant until a window is armed — by --profile-steps here, by
+        # POST /profile on the exporter, or by the capture_profile alert
+        # action. Needs the run dir for its bundles, so it exists exactly
+        # when telemetry does.
+        self._capture = None
+        if config.telemetry_dir:
+            from tpu_ddp.profiler.capture import (
+                CaptureManager,
+                parse_profile_steps,
+            )
+
+            self._capture = CaptureManager(
+                config.telemetry_dir,
+                process_index=self.process_index,
+                window_steps=config.profile_window_steps,
+                host_hz=config.profile_host_hz,
+                telemetry=self.telemetry,
+                run_meta=self.run_meta,
+            )
+            window = parse_profile_steps(config.profile_steps)
+            if window:
+                self._capture.arm_window(*window)
 
         self.model = build_model(config)
         self._load_data(train_data, test_data)
@@ -1191,15 +1252,21 @@ class Trainer:
 
     def _release_workers(self) -> None:
         """Stop the host-side helpers: prefetcher (worker thread + slot
-        buffers), monitor exporter, watchdog, and the health monitor
-        (flushes its JSONL footer). Idempotent; does NOT close the
-        telemetry sinks."""
+        buffers), monitor exporter, profiler capture manager (writes any
+        open window as a truncated bundle), watchdog, and the health
+        monitor (flushes its JSONL footer). Idempotent; does NOT close
+        the telemetry sinks."""
         if self._prefetcher is not None:
             self._prefetcher.close()
             self._prefetcher = None
         if self._exporter is not None:
             self._exporter.close()
             self._exporter = None
+        if self._capture is not None:
+            # a window still open when the run drains is written as a
+            # truncated bundle — a preempted run's capture is evidence
+            # too. The manager stays (idempotent close) for a second call
+            self._capture.close()
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
@@ -1412,6 +1479,11 @@ class Trainer:
                     process_index=self.process_index,
                     watchdog_provider=lambda: self._watchdog,
                     run_dir=c.telemetry_dir,
+                    profile_trigger=(
+                        self._capture.request
+                        if self._capture is not None else None
+                    ),
+                    allow_remote_trigger=c.monitor_allow_remote_trigger,
                 ).start()
                 log.info(
                     "monitor exporter on port %d "
@@ -1537,6 +1609,12 @@ class Trainer:
                     # still catches wedged collectives (the host blocks
                     # inside the NEXT dispatch when the device queue jams)
                     self._watchdog.beat(host_step)
+                if self._capture is not None:
+                    # capture-window lifecycle: opens an armed window when
+                    # its start step arrives, closes + writes the bundle
+                    # when it ends (boundaries snap to dispatch
+                    # boundaries under scan fusion)
+                    self._capture.on_step(host_step)
                 if self._health_monitor is not None:
                     dn = self.steps_per_call if kind == "stacked" else 1
                     verdict = self._on_health(
