@@ -5,7 +5,7 @@ PYTHON ?= python
 
 .PHONY: test test-all dryrun bench smoke capture aot real-data lint \
 	trace-demo health-demo zero-demo compress-demo analyze-demo \
-	lint-demo monitor-demo profile-demo bench-compare
+	lint-demo monitor-demo profile-demo goodput-demo bench-compare
 
 # Fast default loop (round-3 verdict item 5): skips the `slow`-marked
 # multi-process / end-to-end-CLI / AOT tests. CI and pre-commit should run
@@ -158,6 +158,23 @@ profile-demo:
 	rm -rf $(PROFILE_DEMO_DIR)
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
 	  $(PYTHON) -m tpu_ddp.tools.profile_demo --dir $(PROFILE_DEMO_DIR)
+
+# Goodput-ledger acceptance (docs/goodput.md): a 4-device CPU run with
+# step-cadence checkpoints is hard-killed past its last checkpoint (no
+# run_end — a simulated SIGKILL), resumed to completion as incarnation 1
+# (the dead life's trace survives as its own file), with the live
+# goodput/fraction gauge scraped from /metrics MID-RUN; then `tpu-ddp
+# goodput` must report exactly 2 incarnations, nonzero restart-gap and
+# replayed-steps badput (replayed == steps since the last checkpoint),
+# categories summing to elapsed wall-clock within 2%, and a Young–Daly
+# checkpoint-interval recommendation; and `bench compare` must flag the
+# incident ledger against a clean baseline. Exits nonzero on any miss
+# (tpu_ddp/tools/goodput_demo.py).
+GOODPUT_DEMO_DIR ?= /tmp/tpu_ddp_goodput_demo
+goodput-demo:
+	rm -rf $(GOODPUT_DEMO_DIR)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+	  $(PYTHON) -m tpu_ddp.tools.goodput_demo --dir $(GOODPUT_DEMO_DIR)
 
 # Deviceless perf-regression gate: re-capture the AOT artifact with the
 # real XLA:TPU toolchain (needs libtpu; ~30+ min of compiles) and diff

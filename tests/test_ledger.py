@@ -1,0 +1,465 @@
+"""Goodput ledger: cross-incarnation stitching, badput taxonomy, the
+Young–Daly advisor, incarnation-stamped telemetry, and the monitor/
+compare-gate integrations (docs/goodput.md).
+
+The expensive fixtures are two REAL runs on the virtual CPU mesh,
+shared module-wide:
+
+- ``incident_dir`` — the kill→resume path the ledger exists for: a run
+  with step-cadence checkpoints hard-killed past its last checkpoint
+  (exception unwinds the loop, no ``run_end`` — a simulated SIGKILL),
+  then ``--resume``d to completion as incarnation 1.
+- ``clean_dir``    — the control: one clean single-incarnation run that
+  must show ZERO restart/replay badput.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from tpu_ddp.ledger import (
+    build_ledger,
+    ledger_json,
+    mtbf_seconds,
+    recommend_interval,
+    render_ledger,
+    stitch_run,
+    young_daly_interval,
+)
+from tpu_ddp.telemetry import (
+    next_incarnation,
+    parse_trace_name,
+    trace_file_name,
+)
+from tpu_ddp.telemetry.summarize import read_records
+from tpu_ddp.train.trainer import TrainConfig, Trainer
+
+KILL_AT_STEP = 7
+CHECKPOINT_STEPS = 4
+
+
+class _KillAfter:
+    """Raise after N batches: the simulated hard kill (no shutdown code
+    runs, no run_end lands — exactly a SIGKILL's trace signature)."""
+
+    def __init__(self, inner, n_batches):
+        self._inner, self._n = inner, n_batches
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __iter__(self):
+        for i, batch in enumerate(self._inner):
+            if i >= self._n:
+                raise RuntimeError("simulated hard kill")
+            yield batch
+
+    def __len__(self):
+        return len(self._inner)
+
+
+def _config(run_dir, **overrides):
+    base = dict(
+        synthetic_data=True,
+        synthetic_size=320,
+        epochs=1,
+        per_shard_batch=8,
+        model="netresdeep",
+        n_chans1=8,
+        n_blocks=2,
+        n_devices=4,
+        prefetch_depth=0,
+        log_every_epochs=1,
+        telemetry_dir=run_dir,
+        telemetry_sinks="jsonl",
+        telemetry_snapshot_steps=3,
+        checkpoint_dir=os.path.join(run_dir, "ckpt"),
+        checkpoint_steps=CHECKPOINT_STEPS,
+        health="on",
+    )
+    base.update(overrides)
+    return TrainConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def incident_dir(tmp_path_factory):
+    run_dir = str(tmp_path_factory.mktemp("ledger") / "incident")
+    t0 = Trainer(_config(run_dir))
+    assert t0.incarnation == 0
+    t0.train_loader = _KillAfter(t0.train_loader, KILL_AT_STEP)
+    with pytest.raises(RuntimeError, match="simulated hard kill"):
+        t0.run(close=False)  # no close: the dead life writes no run_end
+    time.sleep(0.4)  # a real restart gap for the ledger to account
+    t1 = Trainer(_config(run_dir, resume=True))
+    assert t1.incarnation == 1
+    assert t1.resumed_step == CHECKPOINT_STEPS
+    t1.run(close=False)
+    t1.close()
+    return run_dir
+
+
+@pytest.fixture(scope="module")
+def clean_dir(tmp_path_factory):
+    run_dir = str(tmp_path_factory.mktemp("ledger") / "clean")
+    t = Trainer(_config(run_dir))
+    t.run(close=False)
+    t.close()
+    return run_dir
+
+
+# -- incarnation-stamped artifacts ----------------------------------------
+
+def test_trace_file_name_legacy_and_stamped():
+    assert trace_file_name(0, 0) == "trace-p0.jsonl"
+    assert trace_file_name(0, 0, "chrome") == "trace-p0.trace.json"
+    assert trace_file_name(2, 3) == "trace-p2.i3.jsonl"
+    assert trace_file_name(2, 3, "chrome") == "trace-p2.i3.trace.json"
+    # parse_trace_name is the grammar's one inverse: round-trips every
+    # writer output, rejects non-sink names
+    for pid, inc, kind in ((0, 0, "jsonl"), (2, 3, "jsonl"),
+                           (1, 0, "chrome"), (5, 12, "chrome")):
+        name = trace_file_name(pid, inc, kind)
+        assert parse_trace_name(name) == (pid, inc, kind)
+    assert parse_trace_name("health-p0.jsonl") is None
+    assert parse_trace_name("trace-p0.jsonl.bak") is None
+
+
+def test_next_incarnation_scans_existing_files(tmp_path):
+    d = str(tmp_path)
+    assert next_incarnation(d) == 0
+    assert next_incarnation(None) == 0
+    (tmp_path / "trace-p0.jsonl").write_text("{}\n")
+    assert next_incarnation(d, 0) == 1
+    assert next_incarnation(d, 1) == 0  # other host: independent index
+    (tmp_path / "trace-p0.i1.jsonl").write_text("{}\n")
+    (tmp_path / "trace-p0.i2.trace.json").write_text("{}")
+    assert next_incarnation(d, 0) == 3
+
+
+def test_incident_wrote_per_incarnation_files(incident_dir):
+    names = sorted(os.listdir(incident_dir))
+    assert "trace-p0.jsonl" in names
+    assert "trace-p0.i1.jsonl" in names
+    # the health record is stamped too: the resume must not truncate the
+    # dead life's numerics evidence
+    assert "health-p0.jsonl" in names
+    assert "health-p0.i1.jsonl" in names
+    # the dead life's trace survived the resume untouched (the latent
+    # truncation bug this naming scheme fixes)
+    recs = read_records([os.path.join(incident_dir, "trace-p0.jsonl")])
+    assert any(r.get("type") == "span" for r in recs)
+    assert not any(r.get("name") == "run_end" for r in recs)
+    meta = next(r["run_meta"] for r in recs if r.get("type") == "header")
+    assert meta["incarnation"] == 0
+    recs1 = read_records(
+        [os.path.join(incident_dir, "trace-p0.i1.jsonl")])
+    meta1 = next(r["run_meta"] for r in recs1
+                 if r.get("type") == "header")
+    assert meta1["incarnation"] == 1
+    assert any(r.get("name") == "run_end" for r in recs1)
+
+
+def test_summarize_reads_both_incarnations(incident_dir):
+    from tpu_ddp.telemetry.summarize import find_trace_files, summarize
+
+    files = find_trace_files(incident_dir)
+    assert len(files) == 2
+    out = summarize(incident_dir)
+    assert "compiled_step" in out
+
+
+# -- the ledger -----------------------------------------------------------
+
+def test_kill_resume_ledger(incident_dir):
+    ledger = build_ledger(stitch_run(incident_dir))
+    assert len(ledger.incarnations) == 2
+    first, second = ledger.incarnations
+    assert first.exit == "killed"
+    assert second.exit == "clean"
+    # resume rewound from the kill step to the last checkpoint: the
+    # replayed work is exactly the steps in between
+    assert second.replayed_steps == KILL_AT_STEP - CHECKPOINT_STEPS
+    assert second.first_step == CHECKPOINT_STEPS
+    assert first.executed_through == KILL_AT_STEP
+    assert second.restart_gap_before_s > 0
+    assert ledger.categories["restart_gap"] > 0
+    assert ledger.categories["replayed"] > 0
+    assert ledger.n_failures == 1
+    assert ledger.mtbf_s == pytest.approx(ledger.elapsed_s)
+
+
+def test_categories_sum_to_elapsed(incident_dir, clean_dir):
+    for run_dir in (incident_dir, clean_dir):
+        ledger = build_ledger(stitch_run(run_dir))
+        total = sum(ledger.categories.values())
+        assert total == pytest.approx(ledger.elapsed_s,
+                                      rel=0.02, abs=1e-6)
+        assert all(v >= 0 for v in ledger.categories.values())
+
+
+def test_clean_run_has_zero_restart_badput(clean_dir):
+    ledger = build_ledger(stitch_run(clean_dir))
+    assert len(ledger.incarnations) == 1
+    assert ledger.incarnations[0].exit == "clean"
+    assert ledger.categories["restart_gap"] == 0
+    assert ledger.categories["replayed"] == 0
+    assert ledger.categories["stall"] == 0
+    presence = ledger.category_presence
+    assert "restart_gap" not in presence
+    assert "replayed" not in presence
+    assert "productive" not in presence  # good time never gates
+    # no failure observed -> MTBF (and thus the advisor) must say
+    # "unknown", not fabricate an infinite-reliability recommendation
+    assert ledger.mtbf_s is None
+    assert ledger.recommendation is None
+    assert ledger.goodput_fraction > 0
+
+
+def test_incident_recommendation_and_throughput(incident_dir):
+    ledger = build_ledger(stitch_run(incident_dir))
+    rec = ledger.recommendation
+    assert rec is not None
+    assert rec["optimal_interval_s"] == pytest.approx(
+        young_daly_interval(rec["checkpoint_cost_s"], rec["mtbf_s"]))
+    assert rec.get("optimal_interval_steps", 0) >= 1
+    # effective throughput discounts the replayed steps' images
+    assert ledger.raw_images_per_sec > 0
+    assert ledger.effective_images_per_sec < ledger.raw_images_per_sec
+    assert ledger.replayed_images == pytest.approx(
+        ledger.incarnations[1].replayed_steps * 32)  # global batch 32
+
+
+def test_render_and_json_roundtrip(incident_dir):
+    ledger = build_ledger(stitch_run(incident_dir))
+    text = render_ledger(ledger)
+    assert "goodput" in text
+    assert "restart gap" in text
+    assert "Young–Daly" in text
+    art = ledger_json(ledger)
+    assert art["schema_version"] == 1
+    json.loads(json.dumps(art))  # fully serializable
+    led = art["ledger"]
+    assert led["category_presence"]["restart_gap"] == 1
+    assert len(led["incarnations"]) == 2
+
+
+def test_cli_goodput(incident_dir, tmp_path, capsys):
+    from tpu_ddp.cli.main import main as cli_main
+
+    assert cli_main(["goodput", incident_dir]) == 0
+    out = capsys.readouterr().out
+    assert "incarnations=2" in out
+    assert cli_main(["goodput", str(tmp_path / "nope")]) == 2
+    assert cli_main(["goodput", incident_dir, "--json"]) == 0
+    art = json.loads(capsys.readouterr().out)
+    assert art["ledger"]["total_steps"] > 0
+
+
+# -- restore-side checkpoint telemetry ------------------------------------
+
+def test_restore_telemetry_counters(incident_dir):
+    recs = read_records(
+        [os.path.join(incident_dir, "trace-p0.i1.jsonl")])
+    spans = [r for r in recs if r.get("type") == "span"
+             and r.get("name") == "checkpoint_restore"]
+    assert spans and spans[0]["dur_s"] > 0
+    newest = [r for r in recs if r.get("type") == "counters"][-1]
+    counters = newest["attrs"]["counters"]
+    assert counters.get("checkpoint/restore_seconds", 0) > 0
+    assert counters.get("checkpoint/restores", 0) >= 1
+
+
+def test_duplicate_step_save_is_skipped(tmp_path):
+    """A --checkpoint-steps cadence save colliding with the epoch/final
+    save at the same step must be a FULL no-op: orbax already skips the
+    write, and the telemetry must skip too, or phantom ~0-duration
+    checkpoint spans drag the advisor's measured save-cost median."""
+    import numpy as np
+
+    from tpu_ddp.checkpoint import Checkpointer
+    from tpu_ddp.telemetry import Sink, Telemetry
+    from tpu_ddp.telemetry.registry import Registry
+
+    class _Discard(Sink):
+        def emit(self, event):
+            pass
+
+    reg = Registry()
+    tel = Telemetry([_Discard()], registry=reg)
+    ck = Checkpointer(str(tmp_path), telemetry=tel)
+    state = {"a": np.arange(4, dtype=np.float32)}
+    ck.save(1, state, wait=True)
+    ck.save(1, state, wait=True)  # duplicate: no span, no counters
+    assert reg.counter("checkpoint/saves").value == 1
+    assert reg.counter("checkpoint/completed").value == 1
+    assert reg.histogram("phase/checkpoint").count == 1
+    ck.save(2, state, wait=True)  # a fresh step still saves
+    assert reg.counter("checkpoint/saves").value == 2
+    ck.close()
+
+
+def test_checkpoint_steps_needs_checkpoint_dir():
+    with pytest.raises(ValueError, match="checkpoint-dir"):
+        TrainConfig(synthetic_data=True, checkpoint_steps=5).validate()
+    TrainConfig(synthetic_data=True, checkpoint_steps=5,
+                checkpoint_dir="/tmp/x").validate()
+
+
+def test_aggregator_drains_dead_tail_on_new_incarnation(tmp_path):
+    """A resume that appears between two watch polls must not lose the
+    dead life's unread trailing records when the tail re-points."""
+    from tpu_ddp.monitor.aggregate import FleetAggregator, MonitorConfig
+
+    def lines(*recs):
+        return "".join(json.dumps(r) + "\n" for r in recs)
+
+    old = tmp_path / "trace-p0.jsonl"
+    old.write_text(lines(
+        {"schema_version": 1, "type": "header", "epoch_unix": 1000.0,
+         "pid": 0},
+        {"schema_version": 1, "type": "span", "name": "compiled_step",
+         "ts_s": 1.0, "dur_s": 0.1, "pid": 0, "step": 5},
+    ))
+    agg = FleetAggregator(str(tmp_path), MonitorConfig())
+    agg.poll(now=2000.0)
+    # written after the poll, just before the process died:
+    with open(old, "a") as f:
+        f.write(lines(
+            {"schema_version": 1, "type": "span",
+             "name": "compiled_step", "ts_s": 2.0, "dur_s": 0.1,
+             "pid": 0, "step": 9},
+            {"schema_version": 1, "type": "instant", "name": "run_end",
+             "ts_s": 2.2, "pid": 0},
+        ))
+    (tmp_path / "trace-p0.i1.jsonl").write_text(lines(
+        {"schema_version": 1, "type": "header", "epoch_unix": 1010.0,
+         "pid": 0},
+    ))
+    snap = agg.poll(now=2000.0)
+    host = snap.hosts[0]
+    assert host.step == 9          # the dead life's tail was ingested
+    assert host.ended is False     # ...but its run_end no longer latches
+
+
+# -- live goodput gauges + monitor integration ----------------------------
+
+def test_goodput_gauge_in_final_snapshot(clean_dir):
+    recs = read_records([os.path.join(clean_dir, "trace-p0.jsonl")])
+    newest = [r for r in recs if r.get("type") == "counters"][-1]
+    gauges = newest["attrs"]["gauges"]
+    assert 0 < gauges["goodput/fraction"] <= 1
+    assert gauges["goodput/productive_seconds"] <= \
+        gauges["goodput/elapsed_seconds"]
+
+
+def test_aggregator_follows_newest_incarnation(incident_dir):
+    from tpu_ddp.monitor.aggregate import _per_host, read_fleet_snapshot
+
+    files = _per_host(incident_dir, "trace-p*.jsonl")
+    assert files[0].endswith("trace-p0.i1.jsonl")
+    snap = read_fleet_snapshot(incident_dir)
+    assert snap.hosts[0].ended  # incarnation 1 finished cleanly
+    gf = snap.fleet.get("goodput_fraction")
+    assert isinstance(gf, float) and 0 < gf <= 1
+
+
+def test_watch_renders_goodput(incident_dir):
+    from tpu_ddp.monitor.aggregate import FleetAggregator, MonitorConfig
+    from tpu_ddp.monitor.alerts import AlertEngine
+    from tpu_ddp.monitor.watch import build_report, render_report
+
+    config = MonitorConfig()
+    report = build_report(
+        FleetAggregator(incident_dir, config),
+        AlertEngine(config, actions=(), once=True))
+    assert "goodput" in render_report(report)
+
+
+def test_gdp001_alert_rule():
+    from tpu_ddp.monitor.aggregate import FleetSnapshot, MonitorConfig
+    from tpu_ddp.monitor.alerts import ALERT_RULES, AlertEngine
+
+    assert ALERT_RULES["GDP001"]["kind"] == "threshold"
+
+    def snap(gf):
+        return FleetSnapshot(wall_time=time.time(), run_dir="/r",
+                             fleet={"goodput_fraction": gf})
+
+    engine = AlertEngine(MonitorConfig(goodput_min_fraction=0.5),
+                         actions=(), once=True)
+    edges = engine.evaluate(snap(0.2))
+    assert [e.rule for e in edges] == ["GDP001"]
+    assert edges[0].state == "firing"
+    # recovery resolves the episode (edge-triggered)
+    edges = engine.evaluate(snap(0.8))
+    assert [(e.rule, e.state) for e in edges] == [("GDP001", "resolved")]
+    # default config: the rule is off (short runs are compile-bound)
+    quiet = AlertEngine(MonitorConfig(), actions=(), once=True)
+    assert quiet.evaluate(snap(0.01)) == []
+    with pytest.raises(ValueError):
+        MonitorConfig(goodput_min_fraction=1.5).validate()
+
+
+# -- advisor math ---------------------------------------------------------
+
+def test_young_daly_hand_computed():
+    # C = 2s, M = 400s -> sqrt(2 * 2 * 400) = 40s
+    assert young_daly_interval(2.0, 400.0) == pytest.approx(40.0)
+    with pytest.raises(ValueError):
+        young_daly_interval(0.0, 100.0)
+
+
+def test_mtbf_and_recommendation_verdicts():
+    assert mtbf_seconds(100.0, 0) is None
+    assert mtbf_seconds(100.0, 4) == 25.0
+    assert recommend_interval(checkpoint_cost_s=None, mtbf_s=10) is None
+    assert recommend_interval(checkpoint_cost_s=1.0, mtbf_s=None) is None
+    rec = recommend_interval(checkpoint_cost_s=2.0, mtbf_s=400.0,
+                             steps_per_sec=2.0,
+                             current_interval_s=120.0)
+    assert rec["optimal_interval_s"] == pytest.approx(40.0)
+    assert rec["optimal_interval_steps"] == 80
+    assert "more often" in rec["verdict"]  # 120s cadence vs 40s optimum
+    rec = recommend_interval(checkpoint_cost_s=2.0, mtbf_s=400.0,
+                             current_interval_s=5.0)
+    assert "less often" in rec["verdict"]
+    rec = recommend_interval(checkpoint_cost_s=2.0, mtbf_s=400.0,
+                             current_interval_s=42.0)
+    assert "near the Young–Daly optimum" in rec["verdict"]
+
+
+# -- bench compare gating -------------------------------------------------
+
+def test_compare_gates_goodput_artifacts(incident_dir, tmp_path):
+    from tpu_ddp.analysis.regress import compare, load_artifact
+
+    art = ledger_json(build_ledger(stitch_run(incident_dir)))
+    incident = tmp_path / "incident.json"
+    incident.write_text(json.dumps(art))
+    # a clean baseline: no incident categories, higher goodput
+    base = json.loads(json.dumps(art))
+    for cat in ("restart_gap", "replayed", "stall"):
+        base["ledger"]["category_presence"].pop(cat, None)
+    base["ledger"]["goodput_fraction"] = min(
+        1.0, art["ledger"]["goodput_fraction"] * 2 + 0.2)
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps(base))
+
+    same = compare(load_artifact(str(incident)),
+                   load_artifact(str(incident)))
+    assert same["regressions"] == []
+    drift = compare(load_artifact(str(baseline)),
+                    load_artifact(str(incident)))
+    joined = "\n".join(drift["regressions"])
+    assert "badput/restart_gap" in joined
+    assert "badput/replayed" in joined
+    assert "goodput_fraction" in joined
+    # the reverse direction reads as improvements, not regressions
+    heal = compare(load_artifact(str(incident)),
+                   load_artifact(str(baseline)))
+    assert heal["regressions"] == []
+    assert any("goodput_fraction" in i for i in heal["improvements"])

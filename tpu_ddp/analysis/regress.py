@@ -17,10 +17,13 @@ fusion choices and temp bytes is real). Wall-clock fields
 (``compile_wall_s``) are reported, never gated — they measure the build
 machine, not the program.
 
-Understands three artifact shapes: ``benchmarks/aot_v5e.json``-style
+Understands four artifact shapes: ``benchmarks/aot_v5e.json``-style
 (``{"programs": {name: record}}``), ``tpu-ddp analyze --json`` output
-(``{"anatomy": ...}``), and a bare single program record. Stdlib-only —
-no jax import — so it gates anywhere the JSON lands.
+(``{"anatomy": ...}``), ``tpu-ddp goodput --json`` ledgers
+(``{"ledger": ...}`` — badput category presence gates exactly, the
+goodput fraction with tolerance, wall clock is reported only), and a
+bare single program record. Stdlib-only — no jax import — so it gates
+anywhere the JSON lands.
 """
 
 from __future__ import annotations
@@ -51,7 +54,14 @@ _COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
 #: move on any XLA version bump): tolerance-gated, not exact
 _SOFT_COUNT_KEYS = ("fusion_count",)
 
-_WALL_KEYS = ("compile_wall_s",)
+#: wall-clock fields: reported, never gated — they measure the machine
+#: (or, for a goodput ledger, the incident), not the program
+_WALL_KEYS = ("compile_wall_s", "elapsed_s")
+
+#: HIGHER-is-better fractional metrics (the goodput ledger's headline):
+#: a relative drop beyond tolerance is a regression, a rise an
+#: improvement — mirroring the sized-metric gate with the sign flipped
+_QUALITY_KEYS = ("goodput_fraction",)
 
 
 def load_artifact(path: str) -> Dict[str, dict]:
@@ -66,6 +76,11 @@ def load_artifact(path: str) -> Dict[str, dict]:
     if isinstance(art.get("anatomy"), dict):
         name = art["anatomy"].get("strategy", "anatomy")
         return {name: art["anatomy"]}
+    if isinstance(art.get("ledger"), dict):
+        # `tpu-ddp goodput --json`: category PRESENCE gates exactly (a
+        # fresh restart_gap category = the benched run started failing),
+        # goodput_fraction gates with tolerance, wall clock is noted
+        return {"goodput": art["ledger"]}
     return {"program": art}
 
 
@@ -105,6 +120,8 @@ def _counts(rec: dict) -> Dict[str, int]:
     for rule, n in (rec.get("rule_counts") or {}).items():
         if isinstance(n, (int, float)):
             out[f"lint/{rule}"] = int(n)
+    for cat, present in (rec.get("category_presence") or {}).items():
+        out[f"badput/{cat}"] = int(bool(present))
     return out
 
 
@@ -252,6 +269,19 @@ def compare(old: Dict[str, dict], new: Dict[str, dict],
                     f"{name}: {key}: {ov:.0f} -> {nv:.0f} "
                     f"(-{(ov - nv) / ov:.1%})"
                 )
+        for key in _QUALITY_KEYS:
+            ov, nv = o.get(key), n.get(key)
+            if not (isinstance(ov, (int, float))
+                    and isinstance(nv, (int, float))):
+                continue
+            if nv < ov * (1 - tolerance) and ov - nv > 0.005:
+                regressions.append(
+                    f"{name}: {key}: {ov:.3f} -> {nv:.3f} "
+                    f"(-{(ov - nv) / ov:.1%}, tolerance {tolerance:.0%})"
+                )
+            elif nv > ov * (1 + tolerance) and nv - ov > 0.005:
+                improvements.append(
+                    f"{name}: {key}: {ov:.3f} -> {nv:.3f}")
         for key in _WALL_KEYS:
             ov, nv = o.get(key), n.get(key)
             if isinstance(ov, (int, float)) and isinstance(nv, (int, float)) \

@@ -56,6 +56,17 @@ class Checkpointer:
                 pass
 
     def save(self, step: int, state: Any, wait: bool = False) -> None:
+        # Duplicate-step guard: orbax's should_save silently no-ops a
+        # save whose step is already the latest (e.g. a --checkpoint-steps
+        # cadence save colliding with the epoch-boundary or final save at
+        # the same step). Returning here keeps the phantom save out of
+        # the telemetry too — a ~0-duration "checkpoint" span would drag
+        # the goodput ledger's measured save-cost median (the Young–Daly
+        # C input) toward zero. wait=True still drains in-flight saves.
+        if step == self.manager.latest_step():
+            if wait:
+                self.wait_until_finished()
+            return
         # a plain save declares max-step retention meaningful again: drop
         # any leftover save_as_only intent so it can't shadow this step
         self._clear_marker()
@@ -162,15 +173,28 @@ class Checkpointer:
         return marked if marked is not None else self.manager.latest_step()
 
     def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
-        """Restore into the structure/shardings of `state_template`."""
+        """Restore into the structure/shardings of `state_template`.
+
+        Restore is synchronous (training cannot start without the state),
+        so unlike the async save path one span + one counter pair tells
+        the whole story: ``checkpoint/restore_seconds`` accumulates the
+        blocking wall time and ``checkpoint/restores`` counts the events
+        — the restore-cost input of the goodput ledger's
+        ``checkpoint_restore`` badput category and of the Young–Daly
+        checkpoint-interval advisor (docs/goodput.md)."""
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_template)
+        t0 = time.monotonic()
         with self.telemetry.span("checkpoint_restore", step=step):
-            return self.manager.restore(
+            restored = self.manager.restore(
                 step, args=ocp.args.StandardRestore(abstract)
             )
+        self.telemetry.count(
+            "checkpoint/restore_seconds", round(time.monotonic() - t0, 6))
+        self.telemetry.count("checkpoint/restores")
+        return restored
 
     def close(self) -> None:
         self.wait_until_finished()

@@ -20,6 +20,12 @@ Subcommands:
   bundles (``<run_dir>/profiles/``): trigger/alert provenance, host
   top stacks (folded-stack sampler), measured-vs-predicted per-op
   attribution, and the cross-host straggler diff (docs/profiling.md).
+- ``tpu-ddp goodput <run_dir>`` — cross-incarnation goodput ledger:
+  stitches every kill→``--resume`` life of a logical run into one
+  timeline, classifies every wall-clock second into the badput
+  taxonomy (restart gaps, replayed steps, stalls, checkpoint/compile/
+  data-wait costs), and recommends a Young–Daly checkpoint interval
+  from measured save cost + MTBF (docs/goodput.md).
 - ``tpu-ddp analyze [run_dir]`` — static step-time anatomy: XLA
   cost-model flops/bytes, collective inventory, roofline bound
   classification, per-strategy collective fingerprint; given a run dir,
@@ -108,6 +114,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_ddp.profiler.report import main as profile_main
 
         return profile_main(argv[1:])
+    # goodput is stdlib-only end to end (pure file archaeology)
+    if argv[:1] == ["goodput"]:
+        from tpu_ddp.ledger.report import main as goodput_main
+
+        return goodput_main(argv[1:])
     if argv[:2] == ["bench", "compare"]:
         from tpu_ddp.analysis.regress import main as compare_main
 
@@ -149,6 +160,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="render anomaly-profiler capture bundles: host top stacks, "
              "per-op attribution, straggler diff "
              "(tpu-ddp profile --help)",
+    )
+    sub.add_parser(
+        "goodput",
+        help="cross-incarnation goodput/badput ledger + Young–Daly "
+             "checkpoint-interval advisor over a run dir "
+             "(tpu-ddp goodput --help)",
     )
     sub.add_parser(
         "analyze",

@@ -173,6 +173,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "prediction drawing (ppe_main_ddp.py:355-396)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every-epochs", type=int, default=10)
+    p.add_argument("--checkpoint-steps", type=int, default=0, metavar="N",
+                   help=">0: ALSO save a checkpoint every N global steps "
+                        "(mid-epoch, async) — the cadence knob the "
+                        "goodput ledger's Young–Daly advisor recommends "
+                        "a value for from measured checkpoint cost and "
+                        "MTBF (`tpu-ddp goodput`, docs/goodput.md)")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--eval-only", action="store_true",
                    help="skip training: restore (--resume from "
@@ -436,6 +442,7 @@ def config_from_args(args) -> TrainConfig:
         eval_each_epoch=args.eval_each_epoch,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every_epochs=args.checkpoint_every_epochs,
+        checkpoint_steps=args.checkpoint_steps,
         resume=args.resume,
         compilation_cache_dir=args.compilation_cache_dir,
         keep_best=args.keep_best,

@@ -98,6 +98,7 @@ class HealthMonitor:
         spike_threshold: float = 10.0,
         max_dumps: int = 1,
         run_meta: Optional[dict] = None,
+        incarnation: int = 0,
     ):
         if policy not in POLICIES:
             raise ValueError(
@@ -126,7 +127,13 @@ class HealthMonitor:
         self._fh = None
         if run_dir:
             os.makedirs(run_dir, exist_ok=True)
-            path = os.path.join(run_dir, f"health-p{process_index}.jsonl")
+            # incarnation-stamped like the trace sinks (docs/goodput.md):
+            # mode "w" on the legacy name would truncate the dead life's
+            # numerics record — the exact evidence a post-incident triage
+            # needs — every time a run is resumed in the same dir
+            suffix = f".i{incarnation}" if incarnation else ""
+            path = os.path.join(
+                run_dir, f"health-p{process_index}{suffix}.jsonl")
             self._fh = open(path, "w")
             self._write({
                 "schema_version": HEALTH_SCHEMA_VERSION,

@@ -1,0 +1,187 @@
+"""``tpu-ddp goodput <run_dir>`` — render the cross-incarnation ledger.
+
+Text mode is the operator surface: goodput %, the badput breakdown
+table (whose total row re-derives the elapsed wall-clock — the sum
+identity is printed, not asserted in private), the per-incarnation
+timeline with exit classifications, effective vs raw throughput,
+measured MTBF, and the Young–Daly checkpoint-interval recommendation.
+
+``--json`` emits the schema-versioned artifact ``tpu-ddp bench
+compare`` gates on: category *presence* and the goodput fraction gate
+(a fresh ``restart_gap`` category or a goodput drop is a regression),
+wall-clock totals are report-only. Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Sequence
+
+from tpu_ddp.ledger.stitch import stitch_run
+from tpu_ddp.ledger.taxonomy import CATEGORIES, RunLedger, build_ledger
+
+#: bump on any breaking change to the ``--json`` artifact shape
+LEDGER_SCHEMA_VERSION = 1
+
+
+def ledger_json(ledger: RunLedger) -> dict:
+    """The ``--json`` artifact: ``{"schema_version", "ledger": {...}}``
+    (``bench compare``'s ``load_artifact`` understands this shape)."""
+    return {
+        "schema_version": LEDGER_SCHEMA_VERSION,
+        "type": "goodput_ledger",
+        "ledger": {
+            "run_dir": ledger.run_dir,
+            "run_id": ledger.run_id,
+            "strategy": ledger.strategy,
+            "elapsed_s": ledger.elapsed_s,
+            "goodput_fraction": ledger.goodput_fraction,
+            "category_seconds": dict(ledger.categories),
+            "category_presence": ledger.category_presence,
+            "incarnations": [e.to_json() for e in ledger.incarnations],
+            "total_steps": ledger.total_steps,
+            "replayed_steps": ledger.replayed_steps,
+            "throughput": {
+                "total_images": ledger.total_images,
+                "replayed_images": ledger.replayed_images,
+                "raw_images_per_sec": ledger.raw_images_per_sec,
+                "effective_images_per_sec":
+                    ledger.effective_images_per_sec,
+            },
+            "n_failures": ledger.n_failures,
+            "mtbf_s": ledger.mtbf_s,
+            "checkpoint": {
+                "count": ledger.checkpoint_count,
+                "median_cost_s": ledger.checkpoint_cost_s,
+            },
+            "recommendation": ledger.recommendation,
+            "notes": list(ledger.notes),
+        },
+    }
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    if not isinstance(v, (int, float)):
+        return "-"
+    if v >= 120:
+        return f"{v / 60:.1f}m"
+    return f"{v:.1f}s"
+
+
+def render_ledger(ledger: RunLedger) -> str:
+    lines: List[str] = []
+    label = [f"goodput: {ledger.run_dir}"]
+    if ledger.run_id:
+        label.append(f"run_id={ledger.run_id}")
+    if ledger.strategy:
+        label.append(f"strategy={ledger.strategy}")
+    label.append(f"incarnations={len(ledger.incarnations)}")
+    lines.append("  ".join(label))
+    prod = ledger.categories.get("productive", 0.0)
+    lines.append(
+        f"goodput {ledger.goodput_fraction:.1%} — {prod:.1f}s productive "
+        f"of {ledger.elapsed_s:.1f}s elapsed wall-clock")
+    lines.append("")
+
+    header = (f"{'inc':>4} {'start':>8} {'wall':>8} {'steps':>12} "
+              f"{'exit':<12} {'gap_before':>10} {'replayed':>9}")
+    lines += ["incarnation timeline:", header, "-" * len(header)]
+    for e in ledger.incarnations:
+        span = ("-" if e.first_step is None
+                else f"{e.first_step}..{e.executed_through}")
+        lines.append(
+            f"{e.index:>4} {'+' + _fmt_s(e.start_offset_s):>8} "
+            f"{_fmt_s(e.elapsed_s):>8} {span:>12} {e.exit:<12} "
+            f"{_fmt_s(e.restart_gap_before_s) if e.index else '-':>10} "
+            f"{e.replayed_steps if e.replayed_steps else '-':>9}")
+    lines.append("")
+
+    header = f"{'category':<38} {'seconds':>9} {'share':>7}"
+    lines += ["badput breakdown (sums to elapsed):", header,
+              "-" * len(header)]
+    total = 0.0
+    for cat in CATEGORIES:
+        secs = ledger.categories.get(cat.name, 0.0)
+        total += secs
+        if secs <= 1e-9 and cat.name != "productive":
+            continue
+        share = secs / ledger.elapsed_s if ledger.elapsed_s else 0.0
+        lines.append(f"{cat.title:<38} {secs:>9.2f} {share:>7.1%}")
+    lines.append("-" * len(header))
+    total_share = total / ledger.elapsed_s if ledger.elapsed_s else 0.0
+    lines.append(f"{'total (= elapsed wall-clock)':<38} {total:>9.2f} "
+                 f"{total_share:>7.1%}")
+    lines.append("")
+
+    if ledger.raw_images_per_sec is not None:
+        eff = ledger.effective_images_per_sec
+        lines.append(
+            f"throughput: raw {ledger.raw_images_per_sec:.1f} img/s, "
+            f"effective {eff:.1f} img/s"
+            + (f" (discounting {ledger.replayed_steps} replayed "
+               f"step(s) / {ledger.replayed_images:.0f} images)"
+               if ledger.replayed_steps else " (nothing replayed)"))
+    if ledger.mtbf_s is not None:
+        lines.append(
+            f"MTBF: {_fmt_s(ledger.mtbf_s)} over "
+            f"{ledger.n_failures} failure(s)")
+    else:
+        lines.append("MTBF: not measurable (no failed incarnation)")
+
+    rec = ledger.recommendation
+    if rec:
+        lines.append(
+            f"checkpoint advisor (Young–Daly): save cost "
+            f"{rec['checkpoint_cost_s']:.2f}s, MTBF "
+            f"{_fmt_s(rec['mtbf_s'])} -> optimal interval "
+            f"~{_fmt_s(rec['optimal_interval_s'])}"
+            + (f" (~--checkpoint-steps "
+               f"{rec['optimal_interval_steps']})"
+               if rec.get("optimal_interval_steps") else ""))
+        if rec.get("current_interval_s"):
+            lines.append(
+                f"  current cadence ~{_fmt_s(rec['current_interval_s'])}"
+                f": {rec['verdict']}")
+        else:
+            lines.append(f"  {rec['verdict']}")
+    else:
+        missing = ("no checkpoint observed"
+                   if not ledger.checkpoint_cost_s
+                   else "no failure observed")
+        lines.append(
+            f"checkpoint advisor: no recommendation ({missing} — both "
+            "a measured save cost and a measured MTBF are required)")
+    for note in ledger.notes:
+        lines.append(f"note: {note}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu-ddp goodput",
+        description="cross-incarnation goodput/badput ledger over a run "
+                    "dir's telemetry artifacts (docs/goodput.md)",
+    )
+    ap.add_argument("path", help="run dir (the --telemetry-dir of the "
+                                 "logical run, any number of "
+                                 "incarnations)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the schema-versioned ledger artifact "
+                         "(gate it with `tpu-ddp bench compare`)")
+    args = ap.parse_args(list(argv) if argv is not None else None)
+    try:
+        ledger = build_ledger(stitch_run(args.path))
+    except (FileNotFoundError, ValueError) as e:
+        print(f"tpu-ddp goodput: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(ledger_json(ledger), indent=1))
+    else:
+        print(render_ledger(ledger))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,10 @@ class MonitorConfig:
     data_wait_share_max: float = 0.5       # DWT001 threshold
     grad_norm_mad_threshold: float = 10.0  # NUM001: k over the norm window
     checkpoint_overdue_seconds: float = 0.0  # CKP001 (0 = rule disabled)
+    goodput_min_fraction: float = 0.0      # GDP001: fleet goodput gauge
+                                           # below this fires (0 = rule
+                                           # disabled — short runs are
+                                           # legitimately compile-bound)
     webhook_url: Optional[str] = None      # alert webhook action target
     max_auto_profiles: int = 3             # capture_profile action: alert-
                                            # armed profiler captures per run
@@ -90,6 +94,10 @@ class MonitorConfig:
             raise ValueError("heartbeat_stale_seconds must be > 0")
         if self.straggler_persist_windows < 1:
             raise ValueError("straggler_persist_windows must be >= 1")
+        if not 0.0 <= self.goodput_min_fraction < 1.0:
+            raise ValueError(
+                "goodput_min_fraction must be in [0, 1), got "
+                f"{self.goodput_min_fraction}")
         if self.max_auto_profiles < 0:
             raise ValueError(
                 f"max_auto_profiles must be >= 0, got "
@@ -361,13 +369,21 @@ def _heartbeat_files(run_dir: str) -> Dict[int, str]:
 
 
 def _per_host(run_dir: str, pattern: str) -> Dict[int, str]:
-    """{process_index: path} for a per-host file family in a run dir."""
-    out: Dict[int, str] = {}
+    """{process_index: path} for a per-host file family in a run dir.
+
+    Incarnation-stamped trace names (``trace-p0.i2.jsonl`` — a resumed
+    run's next life; see docs/goodput.md) resolve to the NEWEST
+    incarnation per host: the live monitor watches the life that is
+    actually running, while `tpu-ddp goodput` stitches all of them."""
+    best: Dict[int, tuple] = {}
     for path in sorted(glob.glob(os.path.join(run_dir, pattern))):
-        m = re.search(r"-p(\d+)\.", os.path.basename(path))
-        if m:
-            out[int(m.group(1))] = path
-    return out
+        m = re.search(r"-p(\d+)(?:\.i(\d+))?\.", os.path.basename(path))
+        if not m:
+            continue
+        pid, inc = int(m.group(1)), int(m.group(2) or 0)
+        if pid not in best or inc > best[pid][0]:
+            best[pid] = (inc, path)
+    return {pid: path for pid, (_, path) in best.items()}
 
 
 class FleetAggregator:
@@ -393,9 +409,21 @@ class FleetAggregator:
             ("health-p*.jsonl", _HostState.ingest_health),
         ):
             for pid, path in _per_host(self.run_dir, family).items():
-                tail = self._tails.setdefault(
-                    (family, pid), _JsonlTail(path))
                 state = self._host(pid)
+                tail = self._tails.get((family, pid))
+                if tail is None:
+                    tail = self._tails[(family, pid)] = _JsonlTail(path)
+                elif tail.path != path:
+                    # a NEW incarnation appeared mid-watch (the run was
+                    # resumed): drain the dead life's unread trailing
+                    # records first (its drain instants / final counters
+                    # would otherwise be lost), then follow the live
+                    # file from its start with the previous life's
+                    # clean-shutdown latch cleared
+                    for rec in tail.poll():
+                        ingest(state, rec)
+                    tail = self._tails[(family, pid)] = _JsonlTail(path)
+                    state.ended = False
                 for rec in tail.poll():
                     ingest(state, rec)
 
@@ -501,6 +529,14 @@ class FleetAggregator:
             },
             "data_wait_share": _p50(
                 [h.data_wait_share for h in hosts]),
+            # the trainers' live goodput gauge (productive fraction of
+            # this incarnation's wall-clock, docs/goodput.md), median
+            # across reporting hosts — the GDP001 input and the watch
+            # dashboard's summary figure
+            "goodput_fraction": _p50([
+                st.gauges.get("goodput/fraction")
+                for st in self._hosts.values()
+            ]),
         }
         if ckpt_walls:
             wall, step_at = max(ckpt_walls, key=lambda t: t[0])

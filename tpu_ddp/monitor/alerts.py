@@ -110,6 +110,16 @@ ALERT_RULES: Dict[str, Dict[str, str]] = {
                "preemption now loses that much work — check the "
                "checkpoint storage path and --checkpoint-every-epochs",
     },
+    "GDP001": {
+        "title": "goodput low",
+        "severity": "warning",
+        "kind": "threshold",
+        "fix": "the fleet's productive fraction of wall-clock sits "
+               "below the configured floor: run `tpu-ddp goodput "
+               "<run_dir>` for the badput breakdown (restart gaps, "
+               "replayed steps, data wait, checkpoint cost) and the "
+               "checkpoint-interval recommendation (docs/goodput.md)",
+    },
 }
 
 
@@ -260,6 +270,17 @@ class AlertEngine:
             if (("THR001", None) not in found
                     and ("THR001", None) not in self._active):
                 self._rate_baseline.append(rate)
+
+        if cfg.goodput_min_fraction > 0:
+            gf = snap.fleet.get("goodput_fraction")
+            if (isinstance(gf, (int, float))
+                    and gf < cfg.goodput_min_fraction):
+                found[("GDP001", None)] = (
+                    f"fleet goodput {gf:.0%} below the "
+                    f"{cfg.goodput_min_fraction:.0%} floor — "
+                    "`tpu-ddp goodput` has the badput breakdown",
+                    gf,
+                )
 
         if cfg.checkpoint_overdue_seconds > 0:
             ckpt_age = snap.fleet.get("checkpoint_age_s")

@@ -189,6 +189,11 @@ def render_report(report: dict) -> str:
     dws = fleet.get("data_wait_share")
     if isinstance(dws, (int, float)):
         fleet_bits.append(f"data-wait {dws:.0%}")
+    gf = fleet.get("goodput_fraction")
+    if isinstance(gf, (int, float)):
+        # the trainers' live goodput gauge (this incarnation only);
+        # `tpu-ddp goodput` is the cross-incarnation truth
+        fleet_bits.append(f"goodput {gf:.0%}")
     rl = report.get("roofline") or {}
     if rl.get("mfu") is not None:
         fleet_bits.append(f"MFU {rl['mfu']:.1%}")
@@ -316,6 +321,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     metavar="SECONDS",
                     help=">0: CKP001 fires when the newest checkpoint "
                          "span is older than this")
+    ap.add_argument("--goodput-min", type=float, default=0.0,
+                    metavar="FRACTION",
+                    help=">0: GDP001 fires when the fleet's live "
+                         "goodput gauge falls below this fraction "
+                         "(e.g. 0.5; short runs are legitimately "
+                         "compile-bound, so the rule is opt-in)")
     ap.add_argument("--webhook", default=None, metavar="URL",
                     help="also POST every alert edge as JSON here")
     ap.add_argument("--no-alerts-file", action="store_true",
@@ -343,6 +354,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         heartbeat_stale_seconds=args.stale_seconds,
         data_wait_share_max=args.data_wait_max,
         checkpoint_overdue_seconds=args.checkpoint_overdue,
+        goodput_min_fraction=args.goodput_min,
         webhook_url=args.webhook,
         max_auto_profiles=args.max_auto_profiles,
     )

@@ -44,6 +44,55 @@ from tpu_ddp.telemetry.watchdog import HangWatchdog
 DEFAULT_SINKS = "jsonl,chrome,summary"
 
 
+def trace_file_name(process_index: int, incarnation: int = 0,
+                    kind: str = "jsonl") -> str:
+    """Per-host, per-incarnation sink filename. Incarnation 0 keeps the
+    legacy names (``trace-p<i>.jsonl``) so single-incarnation run dirs
+    look exactly as before; a resumed run's incarnation ``k`` writes
+    ``trace-p<i>.i<k>.jsonl`` instead of truncating the previous
+    incarnation's file — the previous life's spans are evidence the
+    goodput ledger stitches, not scratch to overwrite.
+    ``parse_trace_name`` is the inverse; keep them together."""
+    suffix = f".i{incarnation}" if incarnation else ""
+    ext = {"jsonl": "jsonl", "chrome": "trace.json"}[kind]
+    return f"trace-p{process_index}{suffix}.{ext}"
+
+
+def parse_trace_name(name: str):
+    """Inverse of ``trace_file_name``: ``(process_index, incarnation,
+    kind)`` for a trace sink basename, None for anything else. The ONE
+    parser of the naming grammar — the ledger's incarnation discovery
+    and ``next_incarnation`` both route through it, so the writer and
+    its readers cannot drift."""
+    import re
+
+    m = re.match(
+        r"^trace-p(\d+)(?:\.i(\d+))?\.(jsonl|trace\.json)$", name)
+    if not m:
+        return None
+    kind = "jsonl" if m.group(3) == "jsonl" else "chrome"
+    return int(m.group(1)), int(m.group(2) or 0), kind
+
+
+def next_incarnation(run_dir, process_index: int = 0) -> int:
+    """The incarnation index a process booting into ``run_dir`` should
+    stamp its artifacts with: one past the highest incarnation whose
+    trace files already exist for this host (0 in a fresh dir). Derived
+    purely from the files on disk — no coordination, no sidecar state —
+    so a ``--resume`` after a SIGKILL lands on the right index even
+    though the killed life never ran any shutdown code."""
+    import os
+
+    if not run_dir or not os.path.isdir(run_dir):
+        return 0
+    newest = -1
+    for name in os.listdir(run_dir):
+        parsed = parse_trace_name(name)
+        if parsed and parsed[0] == process_index:
+            newest = max(newest, parsed[1])
+    return newest + 1
+
+
 def build_telemetry(
     run_dir,
     sinks: str = DEFAULT_SINKS,
@@ -51,6 +100,7 @@ def build_telemetry(
     process_index: int = 0,
     jax_hooks: bool = True,
     run_meta=None,
+    incarnation: int = 0,
 ) -> Telemetry:
     """Construct a Telemetry for ``run_dir`` with the named sinks
     (comma-separated subset of ``jsonl,chrome,summary``), or the disabled
@@ -58,7 +108,10 @@ def build_telemetry(
 
     Per-host trace files (``trace-p<i>.jsonl`` / ``trace-p<i>.trace.json``)
     keep multihost runs collision-free in a shared run dir; the terminal
-    summary only prints from process 0.
+    summary only prints from process 0. ``incarnation`` > 0 (a resumed
+    run's next life in the same dir — see ``next_incarnation``) stamps
+    the filenames ``trace-p<i>.i<k>.*`` so each life writes its own
+    files instead of destroying the previous life's record.
 
     ``run_meta`` (a JSON-serializable dict: config snapshot, jax version,
     device kind, mesh shape, strategy, schema_version) is written as the
@@ -77,13 +130,15 @@ def build_telemetry(
     for name in names:
         if name == "jsonl":
             built.append(JsonlTraceSink(
-                os.path.join(run_dir, f"trace-p{process_index}.jsonl"),
+                os.path.join(run_dir, trace_file_name(
+                    process_index, incarnation, "jsonl")),
                 clock=clock, process_index=process_index,
                 run_meta=run_meta,
             ))
         elif name == "chrome":
             built.append(ChromeTraceSink(
-                os.path.join(run_dir, f"trace-p{process_index}.trace.json"),
+                os.path.join(run_dir, trace_file_name(
+                    process_index, incarnation, "chrome")),
                 process_index=process_index, run_meta=run_meta,
             ))
         elif name == "summary":
@@ -124,4 +179,7 @@ __all__ = [
     "HangWatchdog",
     "DEFAULT_SINKS",
     "build_telemetry",
+    "next_incarnation",
+    "parse_trace_name",
+    "trace_file_name",
 ]

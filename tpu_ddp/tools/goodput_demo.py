@@ -1,0 +1,324 @@
+"""``make goodput-demo`` — end-to-end proof of the goodput ledger loop.
+
+The acceptance story the ledger exists for, run as one live circuit on
+the 4-virtual-device CPU mesh (exit nonzero on any miss, so CI runs
+this beside profile-demo as a living gate):
+
+1. **A run dies mid-epoch**: a short training run with step-cadence
+   checkpoints (``--checkpoint-steps``) is hard-killed past its last
+   checkpoint — no ``run_end``, no shutdown code, exactly what a
+   SIGKILL/preemption leaves behind.
+2. **The resume is a new incarnation**: ``--resume`` in the same run
+   dir boots incarnation 1, writes ``trace-p0.i1.jsonl`` (the dead
+   life's trace survives untouched), and serves the live
+   ``goodput/fraction`` gauge on ``/metrics`` mid-run.
+3. **The ledger reconstructs the incident**: ``tpu-ddp goodput --json``
+   must report exactly 2 incarnations, a killed exit, nonzero
+   restart-gap and replayed-steps badput (replayed == steps between the
+   last checkpoint and the kill), categories that sum to elapsed
+   wall-clock within 2%, and a Young–Daly checkpoint-interval
+   recommendation from the measured save cost + MTBF.
+4. **The regression gate sees it**: ``bench compare`` of a clean
+   baseline ledger against the incident ledger must flag the fresh
+   restart-gap/replayed categories and the goodput drop; the incident
+   compared against itself must pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+
+def _fail(msg: str) -> None:
+    print(f"[goodput-demo] FAIL: {msg}", file=sys.stderr)
+
+
+class _KillAfter:
+    """Wrap the trainer's batch loader to raise after N batches — the
+    simulated hard kill. The exception unwinds the run loop without any
+    shutdown telemetry (no run_end), like a SIGKILL would."""
+
+    def __init__(self, inner, n_batches: int):
+        self._inner = inner
+        self._n = n_batches
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __iter__(self):
+        for i, batch in enumerate(self._inner):
+            if i >= self._n:
+                raise RuntimeError("goodput-demo: simulated hard kill")
+            yield batch
+
+    def __len__(self):
+        return len(self._inner)
+
+
+class _SlowLoader:
+    """Small per-batch stall so the resumed run lives long enough for a
+    mid-run /metrics scrape on any CI box."""
+
+    def __init__(self, inner, stall_s: float):
+        self._inner = inner
+        self._stall_s = stall_s
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __iter__(self):
+        for batch in self._inner:
+            time.sleep(self._stall_s)
+            yield batch
+
+    def __len__(self):
+        return len(self._inner)
+
+
+def _config(run_dir: str, **overrides):
+    from tpu_ddp.train.trainer import TrainConfig
+
+    base = dict(
+        synthetic_data=True,
+        synthetic_size=320,
+        epochs=1,
+        per_shard_batch=8,
+        model="netresdeep",
+        n_chans1=8,
+        n_blocks=2,
+        n_devices=4,
+        prefetch_depth=0,
+        log_every_epochs=1,
+        telemetry_dir=run_dir,
+        telemetry_sinks="jsonl",
+        telemetry_snapshot_steps=3,
+        checkpoint_dir=os.path.join(run_dir, "ckpt"),
+        checkpoint_steps=4,
+    )
+    base.update(overrides)
+    return TrainConfig(**base)
+
+
+def run_incident(run_dir: str) -> bool:
+    """Kill a run mid-epoch past its last checkpoint, then resume it to
+    completion while scraping the live goodput gauge."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tpu_ddp.train.trainer import Trainer
+
+    # incarnation 0: checkpoints at steps 4 and 8, killed after step 7
+    # -> 3 steps of replayed work when the resume rewinds to step 4
+    t0 = Trainer(_config(run_dir))
+    steps_per_epoch = t0.train_loader.steps_per_epoch
+    t0.train_loader = _KillAfter(t0.train_loader, 7)
+    try:
+        t0.run(close=False)
+        _fail("the simulated kill never happened")
+        return False
+    except RuntimeError:
+        pass  # the hard kill: no run_end, no sink close
+    print(f"[goodput-demo] incarnation 0 killed at step 7 of "
+          f"{steps_per_epoch} (last checkpoint at step 4)")
+    time.sleep(1.1)  # a real restart gap the ledger must account for
+
+    # incarnation 1: --resume, longer run, live monitor endpoint
+    t1 = Trainer(_config(
+        run_dir, resume=True, epochs=3, monitor_port=-1))
+    if t1.incarnation != 1:
+        _fail(f"resume booted incarnation {t1.incarnation}, expected 1")
+        return False
+    t1.train_loader = _SlowLoader(t1.train_loader, 0.05)
+    done = threading.Event()
+
+    def run():
+        try:
+            t1.run(close=False)
+        finally:
+            done.set()
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+
+    # acceptance criterion: goodput/fraction is scrapeable from the
+    # LIVE run's /metrics (OpenMetrics, run-meta labels)
+    scraped = None
+    endpoint = os.path.join(run_dir, "exporter-p0.json")
+    deadline = time.time() + 300
+    while not done.is_set() and time.time() < deadline:
+        try:
+            with open(endpoint) as f:
+                port = json.load(f)["port"]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=2
+            ).read().decode()
+            for line in body.splitlines():
+                if line.startswith("tpu_ddp_goodput_fraction{"):
+                    scraped = line
+                    break
+        except Exception:
+            pass
+        if scraped:
+            break
+        time.sleep(0.1)
+    thread.join(timeout=600)
+    t1.close()
+    ok = True
+    if not done.is_set():
+        _fail("the resumed run did not finish")
+        return False
+    if scraped is None:
+        _fail("goodput/fraction gauge was never scrapeable from the "
+              "live run's /metrics")
+        ok = False
+    else:
+        print(f"[goodput-demo] live scrape: {scraped}")
+    return ok
+
+
+def check_ledger(run_dir: str) -> bool:
+    """``tpu-ddp goodput`` over the incident run dir: the pinned facts."""
+    import contextlib
+    import io
+
+    from tpu_ddp.cli.main import main as cli_main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["goodput", run_dir, "--json"])
+    if rc != 0:
+        _fail(f"tpu-ddp goodput --json exited {rc}")
+        return False
+    ledger = json.loads(buf.getvalue())["ledger"]
+    ok = True
+    incs = ledger["incarnations"]
+    if len(incs) != 2:
+        _fail(f"expected exactly 2 incarnations, got {len(incs)}")
+        ok = False
+    else:
+        if incs[0]["exit"] != "killed":
+            _fail(f"incarnation 0 exit {incs[0]['exit']!r}, expected "
+                  "'killed'")
+            ok = False
+        if incs[1]["exit"] != "clean":
+            _fail(f"incarnation 1 exit {incs[1]['exit']!r}, expected "
+                  "'clean'")
+            ok = False
+        if incs[1]["replayed_steps"] != 3:
+            _fail(f"replayed_steps {incs[1]['replayed_steps']}, expected "
+                  "3 (kill at step 7, checkpoint at step 4)")
+            ok = False
+    cats = ledger["category_seconds"]
+    for must_be_nonzero in ("restart_gap", "replayed"):
+        if cats.get(must_be_nonzero, 0.0) <= 0:
+            _fail(f"badput category {must_be_nonzero!r} is zero in the "
+                  "incident ledger")
+            ok = False
+    total = sum(cats.values())
+    elapsed = ledger["elapsed_s"]
+    if abs(total - elapsed) > 0.02 * elapsed:
+        _fail(f"categories sum to {total:.2f}s but elapsed is "
+              f"{elapsed:.2f}s (beyond the 2% identity tolerance)")
+        ok = False
+    rec = ledger.get("recommendation")
+    if not rec or not rec.get("optimal_interval_s"):
+        _fail("no Young–Daly checkpoint-interval recommendation in the "
+              "incident ledger")
+        ok = False
+    else:
+        print(f"[goodput-demo] ledger: goodput "
+              f"{ledger['goodput_fraction']:.1%}, restart gap "
+              f"{cats['restart_gap']:.2f}s, replayed "
+              f"{cats['replayed']:.2f}s, recommendation "
+              f"~{rec['optimal_interval_s']:.1f}s"
+              + (f" (--checkpoint-steps "
+                 f"{rec['optimal_interval_steps']})"
+                 if rec.get("optimal_interval_steps") else ""))
+    # the human rendering must also hold the sum identity on its face
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["goodput", run_dir])
+    if rc != 0 or "sums to elapsed" not in buf.getvalue():
+        _fail("text report failed to render")
+        ok = False
+    return ok
+
+
+def check_compare_gate(run_dir: str, scratch: str) -> bool:
+    """The incident ledger must trip `bench compare` against a clean
+    baseline (fresh badput categories + goodput drop) and pass against
+    itself."""
+    import contextlib
+    import io
+
+    from tpu_ddp.cli.main import main as cli_main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cli_main(["goodput", run_dir, "--json"])
+    incident = json.loads(buf.getvalue())
+    # a clean-run baseline: same shape, no incident categories, higher
+    # goodput — what a healthy CI bench run would have committed
+    baseline = json.loads(json.dumps(incident))
+    for cat in ("restart_gap", "replayed", "stall"):
+        baseline["ledger"]["category_presence"].pop(cat, None)
+        baseline["ledger"]["category_seconds"].pop(cat, None)
+    baseline["ledger"]["goodput_fraction"] = min(
+        1.0, incident["ledger"]["goodput_fraction"] * 2 + 0.2)
+    old_path = os.path.join(scratch, "ledger_baseline.json")
+    new_path = os.path.join(scratch, "ledger_incident.json")
+    with open(old_path, "w") as f:
+        json.dump(baseline, f)
+    with open(new_path, "w") as f:
+        json.dump(incident, f)
+    ok = True
+    with contextlib.redirect_stdout(io.StringIO()):
+        rc_same = cli_main(["bench", "compare", new_path, new_path])
+    if rc_same != 0:
+        _fail(f"self-compare of the incident ledger exited {rc_same}")
+        ok = False
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc_drift = cli_main(["bench", "compare", old_path, new_path])
+    out = buf.getvalue()
+    if rc_drift != 1:
+        _fail(f"clean-vs-incident compare exited {rc_drift}, expected 1")
+        ok = False
+    if "badput/restart_gap" not in out or "goodput_fraction" not in out:
+        _fail("compare did not name the fresh restart_gap category and "
+              "the goodput drop:\n" + out)
+        ok = False
+    if ok:
+        print("[goodput-demo] compare gate: incident regresses vs clean "
+              "baseline, self-compare clean")
+    return ok
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="goodput ledger end-to-end demo (kill -> resume -> "
+                    "ledger -> compare gate)")
+    ap.add_argument("--dir", required=True,
+                    help="scratch dir for the kill/resume run")
+    args = ap.parse_args(argv)
+    os.makedirs(args.dir, exist_ok=True)
+    run_dir = os.path.join(args.dir, "incident")
+
+    ok = run_incident(run_dir)
+    ok &= check_ledger(run_dir)
+    ok &= check_compare_gate(run_dir, args.dir)
+    if ok:
+        print("[goodput-demo] OK: kill -> resume -> 2-incarnation "
+              "ledger with restart-gap/replayed badput + Young–Daly "
+              f"recommendation; inspect with: tpu-ddp goodput {run_dir}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -159,6 +159,13 @@ class TrainConfig:
     eval_each_epoch: bool = False
     checkpoint_dir: Optional[str] = None
     checkpoint_every_epochs: int = 10     # save on log epochs, main.py:45
+    checkpoint_steps: int = 0             # >0: ALSO checkpoint every N
+                                          # global steps (mid-epoch) — the
+                                          # cadence knob the goodput
+                                          # ledger's Young–Daly advisor
+                                          # recommends a value for
+                                          # (docs/goodput.md); epoch-
+                                          # boundary saves still happen
     keep_best: bool = False               # also retain the best-test-acc
                                           # checkpoint under
                                           # <checkpoint-dir>/best
@@ -289,6 +296,15 @@ class TrainConfig:
             raise ValueError(
                 "telemetry_snapshot_steps must be >= 0, got "
                 f"{self.telemetry_snapshot_steps}"
+            )
+        if self.checkpoint_steps < 0:
+            raise ValueError(
+                f"checkpoint_steps must be >= 0, got {self.checkpoint_steps}"
+            )
+        if self.checkpoint_steps and not self.checkpoint_dir:
+            raise ValueError(
+                "--checkpoint-steps needs --checkpoint-dir: there is "
+                "nowhere to save the step-cadence checkpoints"
             )
         if self.monitor_port < -1 or self.monitor_port > 65535:
             raise ValueError(
@@ -491,7 +507,11 @@ class Trainer:
         # kind + mesh + strategy) lands as the first record of every file
         # sink, so `tpu-ddp analyze`/`trace summarize` can label this run
         # and refuse mismatched ones — run dirs used to be anonymous.
-        from tpu_ddp.telemetry import RUN_META_SCHEMA_VERSION, build_telemetry
+        from tpu_ddp.telemetry import (
+            RUN_META_SCHEMA_VERSION,
+            build_telemetry,
+            next_incarnation,
+        )
 
         # run_id: a short stable config digest — deterministic, so every
         # host of a multihost run derives the SAME id without a
@@ -504,9 +524,19 @@ class Trainer:
             json.dumps(config_snapshot, sort_keys=True,
                        default=str).encode()
         ).hexdigest()[:10]
+        # incarnation: which life of this logical run this process is —
+        # derived from the trace files already in the run dir, so a
+        # --resume after a preemption/SIGKILL gets a fresh monotonic
+        # index with zero coordination. Incarnation k > 0 writes
+        # trace-p<i>.i<k>.jsonl instead of truncating the dead life's
+        # file; the goodput ledger stitches all of them back into one
+        # cross-incarnation timeline (docs/goodput.md).
+        self.incarnation = next_incarnation(
+            config.telemetry_dir, self.process_index)
         self.run_meta = {
             "run_meta_schema_version": RUN_META_SCHEMA_VERSION,
             "run_id": run_id,
+            "incarnation": self.incarnation,
             "config": config_snapshot,
             "jax_version": jax.__version__,
             "device_kind": devices[0].device_kind,
@@ -521,6 +551,7 @@ class Trainer:
             config.telemetry_sinks,
             process_index=self.process_index,
             run_meta=self.run_meta,
+            incarnation=self.incarnation,
         )
         self._watchdog = None
         self._exporter = None   # monitor HTTP endpoint (started in run())
@@ -557,6 +588,7 @@ class Trainer:
                 window=config.health_window,
                 spike_threshold=config.health_spike_threshold,
                 run_meta=dataclasses.asdict(config),
+                incarnation=self.incarnation,
             )
         if config.profile_dir:
             # satellite fix: create the profiler dir up front — a typo'd
@@ -1455,6 +1487,22 @@ class Trainer:
         tel = self.telemetry
         throughput = Throughput(n_chips=n_local_chips, registry=tel.registry)
         throughput.start()
+        # Goodput accounting baseline: the registry is process-global
+        # (histograms may carry a previous Trainer's sums in the same
+        # process), so the live goodput gauges and the ledger's per-
+        # incarnation counter deltas both measure AGAINST this snapshot.
+        # The baseline record lands in the trace right after the header,
+        # which is what lets `tpu-ddp goodput` attribute compile seconds
+        # to the incarnation that actually paid them.
+        reg = tel.registry
+        self._goodput_baseline = {
+            "wall": time.time(),
+            "compiled": reg.histogram("phase/compiled_step").sum,
+            "sync": reg.histogram("phase/device_sync").sum,
+            "compile": reg.histogram("jax/compile_seconds").sum,
+        }
+        if tel.enabled:
+            tel.emit_counters(name="counters_baseline")
         if c.watchdog_deadline_seconds > 0:
             from tpu_ddp.telemetry import HangWatchdog
 
@@ -1545,6 +1593,8 @@ class Trainer:
                 tel.enabled
                 or self._watchdog is not None
                 or self._health_monitor is not None
+                or (self.checkpointer is not None
+                    and c.checkpoint_steps > 0)
             )
             host_step = int(self.state.step) if track_step else 0
             tel.current_step = host_step
@@ -1602,6 +1652,7 @@ class Trainer:
                     if snap_every and (host_step // snap_every) > (
                         (host_step - dn) // snap_every
                     ):
+                        self._update_goodput_gauges(tel)
                         tel.emit_counters(name="counters_snapshot")
                 if self._watchdog is not None:
                     # without tracing the dispatch is async: the beat then
@@ -1615,6 +1666,17 @@ class Trainer:
                     # when it ends (boundaries snap to dispatch
                     # boundaries under scan fusion)
                     self._capture.on_step(host_step)
+                if (self.checkpointer is not None and c.checkpoint_steps
+                        and (host_step // c.checkpoint_steps)
+                        > ((host_step
+                            - (self.steps_per_call if kind == "stacked"
+                               else 1)) // c.checkpoint_steps)):
+                    # step-cadence save (--checkpoint-steps): the knob
+                    # the goodput ledger's Young–Daly advisor recommends
+                    # a value for. Async initiation, same as the epoch-
+                    # boundary saves; a fused group checkpoints once at
+                    # the boundary it crosses.
+                    self.checkpointer.save(host_step, self._ckpt_state())
                 if self._health_monitor is not None:
                     dn = self.steps_per_call if kind == "stacked" else 1
                     verdict = self._on_health(
@@ -1687,6 +1749,12 @@ class Trainer:
                        "no --checkpoint-dir, progress will NOT survive")
                 )
                 last_metrics["preempted"] = True
+                if tel.enabled:
+                    # exit-classification evidence for the goodput
+                    # ledger: a drained run's run_end alone would read
+                    # as a clean finish, hiding the interruption MTBF
+                    # is computed from
+                    tel.instant("preempt_drain", step=host_step)
                 break  # the tail below writes the final checkpoint
             if self._health_halted is not None:
                 self.logger.log_text(
@@ -1696,6 +1764,9 @@ class Trainer:
                        else "")
                 )
                 last_metrics["health_halted"] = True
+                if tel.enabled:
+                    tel.instant("health_halt_drain",
+                                step=self._health_halted)
                 break  # same drain path as preemption
             if epoch > start_epoch + 1:  # device_get above = a sync boundary
                 steady_seconds += (
@@ -1792,6 +1863,7 @@ class Trainer:
                     tel.count("comm/grad_bytes_uncompressed",
                               n_steps * base)
                 record_memory_gauges(tel.registry)
+                self._update_goodput_gauges(tel)
                 tel.emit_counters()
         throughput.stop(wait_for=self.state.params)
         total = time.time() - start
@@ -1852,6 +1924,34 @@ class Trainer:
             record_mfu(tel.registry, last_metrics.get("mfu"))
             # final snapshot lands via tel.close() in Trainer.close()
         return last_metrics
+
+    def _update_goodput_gauges(self, tel) -> None:
+        """Live goodput gauges for /metrics and the watch dashboard:
+        the fraction of THIS incarnation's wall-clock spent in productive
+        step execution (compiled_step + device_sync span time, minus jax
+        compile seconds — the compile happens inside the first spans).
+        Measured as deltas against the run-start baseline so a process-
+        global registry (tests, multiple Trainers per process) can't
+        leak another run's sums in. The post-hoc cross-incarnation
+        truth is `tpu-ddp goodput` (docs/goodput.md); these gauges are
+        its live, single-life approximation."""
+        base = getattr(self, "_goodput_baseline", None)
+        if base is None:
+            return
+        reg = tel.registry
+        elapsed = time.time() - base["wall"]
+        if elapsed <= 0:
+            return
+        productive = (
+            (reg.histogram("phase/compiled_step").sum - base["compiled"])
+            + (reg.histogram("phase/device_sync").sum - base["sync"])
+            - max(0.0, reg.histogram("jax/compile_seconds").sum
+                  - base["compile"])
+        )
+        productive = min(max(productive, 0.0), elapsed)
+        tel.gauge("goodput/fraction").set(productive / elapsed)
+        tel.gauge("goodput/productive_seconds").set(productive)
+        tel.gauge("goodput/elapsed_seconds").set(elapsed)
 
     def _on_health(self, step_base, health_out, kind, dev_batch) -> str:
         """Feed one dispatch's in-graph health stats to the monitor: ONE
