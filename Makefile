@@ -5,7 +5,8 @@ PYTHON ?= python
 
 .PHONY: test test-all dryrun bench smoke capture aot real-data lint \
 	trace-demo health-demo zero-demo compress-demo analyze-demo \
-	lint-demo monitor-demo profile-demo goodput-demo bench-compare
+	lint-demo monitor-demo profile-demo goodput-demo registry-demo \
+	bench-compare
 
 # Fast default loop (round-3 verdict item 5): skips the `slow`-marked
 # multi-process / end-to-end-CLI / AOT tests. CI and pre-commit should run
@@ -175,6 +176,22 @@ goodput-demo:
 	rm -rf $(GOODPUT_DEMO_DIR)
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
 	  $(PYTHON) -m tpu_ddp.tools.goodput_demo --dir $(GOODPUT_DEMO_DIR)
+
+# Perf-registry acceptance (docs/registry.md): a real 4-device CPU run's
+# analyze/goodput/trace-summary artifacts must record into a fresh
+# registry workspace provenance-stamped (git commit + the run's
+# deterministic config digest); synthetic multi-commit history with an
+# injected 10% throughput drift must trip `registry trend` with exactly
+# REG001 while an equally long clean history stays quiet; and
+# `bench compare --against <registry>` must auto-select its baseline
+# (pass vs the candidate's own entry, fail vs a poisoned entry with one
+# collective dropped, refuse with a named reason on a digest mismatch).
+# Exits nonzero on any miss (tpu_ddp/tools/registry_demo.py).
+REGISTRY_DEMO_DIR ?= /tmp/tpu_ddp_registry_demo
+registry-demo:
+	rm -rf $(REGISTRY_DEMO_DIR)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+	  $(PYTHON) -m tpu_ddp.tools.registry_demo --dir $(REGISTRY_DEMO_DIR)
 
 # Deviceless perf-regression gate: re-capture the AOT artifact with the
 # real XLA:TPU toolchain (needs libtpu; ~30+ min of compiles) and diff

@@ -915,6 +915,19 @@ def child_main(quick: bool) -> None:
         f"budget={deadline - time.time():.0f}s",
         file=sys.stderr, flush=True,
     )
+    # Provenance header (same fields as a run dir's metadata): which
+    # commit produced this capture, which logical bench config (the
+    # deterministic digest keys the perf-registry series), which chip.
+    try:
+        from tpu_ddp.telemetry.provenance import artifact_provenance
+
+        provenance = artifact_provenance(
+            descriptor={"artifact": "bench.py", "quick": quick,
+                        "n_chips": len(jax.devices())},
+            device_kind=kind, jax_version=jax.__version__,
+        )
+    except Exception:
+        provenance = None
     try:
         flagship = _bench_flagship(quick)
     except Exception:
@@ -934,6 +947,8 @@ def child_main(quick: bool) -> None:
         "device_kind": kind,
         "flagship": {k: v for k, v in flagship.items() if k != "error"},
     }
+    if provenance:
+        headline["provenance"] = provenance
     if "error" in flagship:
         headline["error"] = flagship["error"]
     _emit(headline)  # the artifact is safe from this point on

@@ -156,11 +156,19 @@ def main() -> None:
     print(f"aot_v5e: topology v5e:2x4 -> {len(topo.devices)} x {kind} "
           f"over {n_hosts} hosts", flush=True)
 
+    from tpu_ddp.telemetry.provenance import artifact_provenance
+
     results: dict = {
         "topology": "v5e:2x4",
         "device_kind": kind,
         "n_devices": len(topo.devices),
         "n_hosts": n_hosts,
+        # same provenance header as run dirs: commit identity + the
+        # deterministic config digest the perf registry series on
+        "provenance": artifact_provenance(
+            descriptor={"artifact": "aot_v5e", "topology": "v5e:2x4"},
+            device_kind=kind, jax_version=jax.__version__,
+        ),
         "note": "compile-only (deviceless AOT against the real XLA:TPU + "
                 "Mosaic toolchain in libtpu); execution evidence lives in "
                 "bench_tpu.json",

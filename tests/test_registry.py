@@ -1,0 +1,667 @@
+"""Perf registry: archive, provenance, trend detection, auto-baseline.
+
+Covers the docs/registry.md contract:
+
+- record/round-trip for every artifact family the framework emits
+  (aot programs / analyze / lint / goodput ledger / watch snapshot /
+  trace summary / bench record)
+- provenance stamping: embedded header wins, record-time git probe
+  fills in, graceful nulls outside a repo
+- trend detection: an injected 10% throughput drift trips exactly
+  REG001 on synthetic multi-commit history; an equally long clean
+  history stays quiet; an exact-count increase trips REG003
+- auto-baseline selection: newest clean entry matching (config digest,
+  chip, artifact family); every refusal is named
+- ``registry diff`` parity with ``bench compare`` exit codes
+- CLI ``--json`` schemas, including ``trace summarize --json``
+"""
+
+import json
+
+import pytest
+
+from tpu_ddp.registry.store import (
+    RegistryEntry,
+    candidate_identity,
+    find_entry,
+    read_entries,
+    record_artifact,
+    select_baseline,
+)
+from tpu_ddp.registry.trend import TREND_RULES, TrendConfig, trend_findings
+from tpu_ddp.telemetry.provenance import (
+    artifact_provenance,
+    config_digest,
+    git_provenance,
+)
+
+CLEAN_COMMIT = "c" * 40
+
+
+def _write(tmp_path, name, payload):
+    path = tmp_path / name
+    path.write_text(json.dumps(payload))
+    return str(path)
+
+
+def _prov(digest="cfg0000001", commit=CLEAN_COMMIT, dirty=False,
+          device_kind="cpu", **extra):
+    return {"config_digest": digest, "git_commit": commit,
+            "git_dirty": dirty, "device_kind": device_kind, **extra}
+
+
+def _bench_artifact(value=1000.0, digest="cfgbench01", commit=CLEAN_COMMIT,
+                    dirty=False, device_kind="TPU v5 lite"):
+    return {
+        "metric": "resnet50_bf16_train_images_per_sec_per_chip",
+        "value": value, "unit": "images/sec/chip", "mfu": 0.33,
+        "rows": {"compute_bound_resnet50_bf16": {"value": value,
+                                                 "mfu": 0.33}},
+        "provenance": _prov(digest, commit, dirty, device_kind),
+    }
+
+
+def _analyze_artifact(extra_collective=False):
+    inv = {"all-reduce/f32/data/g4": {"count": 2, "payload_bytes": 1 << 20,
+                                      "group_size": 4}}
+    if extra_collective:
+        inv["all-gather/f32/data/g4"] = {"count": 1,
+                                         "payload_bytes": 4096,
+                                         "group_size": 4}
+    return {
+        "anatomy": {"strategy": "dp", "model": "netresdeep",
+                    "device_kind": "cpu", "flops": 1e9,
+                    "bytes_accessed": 1 << 24, "inventory": inv},
+        "roofline": {"bound": "hbm"},
+        "run_meta": {"run_id": "run0000001", "device_kind": "cpu",
+                     "strategy": "dp", "jax_version": "0.0-test",
+                     "git_commit": CLEAN_COMMIT, "git_dirty": False},
+        "provenance": _prov("run0000001"),
+    }
+
+
+# -- provenance -------------------------------------------------------------
+
+def test_git_provenance_inside_repo():
+    prov = git_provenance("/root/repo")
+    assert isinstance(prov["git_commit"], str)
+    assert len(prov["git_commit"]) == 40
+    assert prov["git_dirty"] in (True, False)
+
+
+def test_git_provenance_no_git_fallback(tmp_path):
+    prov = git_provenance(str(tmp_path))
+    assert prov == {"git_commit": None, "git_dirty": None}
+
+
+def test_config_digest_matches_trainer_recipe():
+    # the PR 7 run_id recipe, verbatim — the registry's identity space
+    # and the Trainer's must be one
+    import hashlib
+
+    snap = {"model": "netresdeep", "epochs": 3, "lr": 0.01}
+    expected = hashlib.sha1(
+        json.dumps(snap, sort_keys=True, default=str).encode()
+    ).hexdigest()[:10]
+    assert config_digest(snap) == expected
+    assert config_digest(snap) == config_digest(dict(reversed(
+        list(snap.items()))))
+
+
+def test_artifact_provenance_run_id_wins_over_descriptor():
+    prov = artifact_provenance(run_id="runabc1234",
+                               descriptor={"x": 1}, device_kind="cpu")
+    assert prov["config_digest"] == "runabc1234"
+    assert prov["run_id"] == "runabc1234"
+    prov2 = artifact_provenance(descriptor={"x": 1})
+    assert prov2["config_digest"] == config_digest({"x": 1})
+
+
+# -- record / round-trip per artifact family --------------------------------
+
+def test_record_round_trip_every_family(tmp_path):
+    reg = str(tmp_path / "reg")
+    ledger = {
+        "schema_version": 1, "type": "goodput_ledger",
+        "ledger": {"run_id": "run0000001", "goodput_fraction": 0.83,
+                   "elapsed_s": 100.0,
+                   "category_presence": {"productive": True,
+                                         "compile": True},
+                   "throughput": {"raw_images_per_sec": 5000.0,
+                                  "effective_images_per_sec": 4900.0},
+                   "device_kind": "cpu"},
+    }
+    watch = {
+        "schema_version": 2,
+        "snapshot": {"run_id": "run0000001", "device_kind": "cpu",
+                     "fleet": {"steps_per_sec": 12.5}},
+        "alerts": [],
+    }
+    summary = {
+        "trace_summary_schema_version": 1, "type": "trace_summary",
+        "run_meta": {"run_id": "run0000001", "device_kind": "cpu"},
+        "phases": {"compiled_step": {"count": 5, "p50_s": 0.02,
+                                     "p95_s": 0.03, "max_s": 0.04,
+                                     "total_s": 0.1}},
+        "counters": {},
+    }
+    aot = {
+        "topology": "v5e:2x4", "device_kind": "TPU v5 lite",
+        "provenance": _prov("cfgaot0001", device_kind="TPU v5 lite"),
+        "programs": {"dp_netresdeep_b32x8": {
+            "ok": True, "argument_size_in_bytes": 1 << 20,
+            "inventory": {"all-reduce/f32/data/g8": {
+                "count": 1, "payload_bytes": 2048, "group_size": 8}}}},
+    }
+    lint = {
+        "lint_schema_version": 1,
+        "provenance": _prov("cfglint001"),
+        "programs": {"dp": {"strategy": "dp",
+                            "rule_counts": {"DON001": 0}},
+                     "source": {"rule_counts": {}}},
+    }
+    families = {
+        "bench": _bench_artifact(),
+        "analyze": _analyze_artifact(),
+        "goodput_ledger": ledger,
+        "watch_snapshot": watch,
+        "trace_summary": summary,
+        "aot": aot,
+        "lint": lint,
+    }
+    for i, (kind, art) in enumerate(families.items()):
+        path = _write(tmp_path, f"{kind}.json", art)
+        entry = record_artifact(reg, path, now=1000.0 + i)
+        assert entry.artifact_kind == kind, (kind, entry.artifact_kind)
+        assert entry.metrics, kind
+
+    entries = read_entries(reg)
+    assert [e.artifact_kind for e in entries] == list(families)
+    by_kind = {e.artifact_kind: e for e in entries}
+    # run-derived artifacts share the run's digest; captures use theirs
+    assert by_kind["analyze"].config_digest == "run0000001"
+    assert by_kind["goodput_ledger"].config_digest == "run0000001"
+    assert by_kind["watch_snapshot"].config_digest == "run0000001"
+    assert by_kind["trace_summary"].config_digest == "run0000001"
+    assert by_kind["aot"].config_digest == "cfgaot0001"
+    # the ledger record's own identity fields reach the entry
+    assert by_kind["goodput_ledger"].device_kind == "cpu"
+    assert by_kind["aot"].device_kind == "TPU v5 lite"
+    # the metric namespace carries each family's headline
+    assert by_kind["bench"].metrics["program/measured/value"] == 1000.0
+    assert by_kind["goodput_ledger"].metrics[
+        "goodput/quality/goodput_fraction"] == 0.83
+    assert by_kind["goodput_ledger"].metrics[
+        "goodput/count/badput/compile"] == 1.0
+    assert by_kind["watch_snapshot"].metrics[
+        "program/measured/steps_per_sec"] == 12.5
+    assert by_kind["trace_summary"].metrics[
+        "trace_summary/wall/phase/compiled_step_p50_s"] == 0.02
+    assert by_kind["aot"].metrics[
+        "dp_netresdeep_b32x8/count/inventory/all-reduce/f32/data/g8"] == 1
+    assert by_kind["lint"].metrics["dp/count/lint/DON001"] == 0.0
+
+
+def test_record_probe_fills_missing_provenance(tmp_path):
+    # artifact with no provenance at all, recorded from a non-repo cwd:
+    # entry still lands, with nulls + a derived config digest
+    path = _write(tmp_path, "bare.json", {"flops": 123.0})
+    entry = record_artifact(str(tmp_path / "reg"), path,
+                            cwd=str(tmp_path))
+    assert entry.provenance["git_commit"] is None
+    assert entry.provenance["git_dirty"] is None
+    assert entry.provenance["config_digest"]
+    assert entry.provenance["config_digest_source"] == "derived:programs"
+    assert not entry.clean  # unattributable != clean
+
+
+def test_record_embedded_provenance_wins_over_probe(tmp_path):
+    path = _write(tmp_path, "a.json", _bench_artifact(
+        commit="e" * 40, dirty=False))
+    entry = record_artifact(str(tmp_path / "reg"), path)
+    assert entry.provenance["git_commit"] == "e" * 40
+    assert entry.provenance["git_dirty"] is False
+    assert entry.clean
+
+
+def test_record_refuses_non_object_artifact(tmp_path):
+    path = tmp_path / "bad.json"
+    path.write_text("[1, 2, 3]")
+    with pytest.raises(ValueError):
+        record_artifact(str(tmp_path / "reg"), str(path))
+
+
+def test_read_entries_skips_torn_line_refuses_future(tmp_path):
+    reg = tmp_path / "reg"
+    path = _write(tmp_path, "a.json", _bench_artifact())
+    record_artifact(str(reg), path)
+    with open(reg / "registry.jsonl", "a") as f:
+        f.write(json.dumps({"registry_schema_version": 99,
+                            "type": "registry_entry"}) + "\n")
+        f.write('{"torn": ')  # crash mid-append leaves this tail
+    with pytest.raises(ValueError, match="newer"):
+        read_entries(str(reg))
+    # with only the torn tail (no future record), reads succeed
+    lines = (reg / "registry.jsonl").read_text().splitlines()
+    (reg / "registry.jsonl").write_text(lines[0] + "\n" + '{"torn": ')
+    assert len(read_entries(str(reg))) == 1
+
+
+# -- trend ------------------------------------------------------------------
+
+def _history_entries(values, *, digest="cfgAAAAAAA", chip="TPU v5 lite",
+                     dirty=False, metric="program/measured/value"):
+    return [
+        RegistryEntry(
+            entry_id=f"e{i:012d}", recorded_at=1000.0 + i,
+            artifact_kind="bench", artifact_path=None,
+            config_digest=digest, device_kind=chip,
+            provenance={"git_commit": f"{i:040x}", "git_dirty": dirty},
+            programs={}, metrics={metric: float(v)},
+        )
+        for i, v in enumerate(values)
+    ]
+
+
+CLEAN_HISTORY = [9000, 9010, 8995, 9002, 9008, 8998, 9005, 9001]
+
+
+def test_trend_quiet_on_clean_history():
+    assert trend_findings(_history_entries(CLEAN_HISTORY)) == []
+
+
+def test_trend_flags_injected_10pct_throughput_drift():
+    findings = trend_findings(_history_entries(CLEAN_HISTORY + [8100]))
+    assert [f.rule for f in findings] == ["REG001"]
+    f = findings[0]
+    assert f.metric == "program/measured/value"
+    assert f.entry_id == "e000000000008"
+    assert f.value == 8100.0
+    assert f.severity == TREND_RULES["REG001"]["severity"]
+    # and the finding names the offending commit for the bisect
+    assert f.git_commit == f"{8:040x}"
+
+
+def test_trend_lower_better_growth_is_reg002():
+    entries = _history_entries(
+        [100, 101, 100, 99, 100, 100, 130],
+        metric="prog/size/temp_bytes")
+    findings = trend_findings(entries)
+    assert [f.rule for f in findings] == ["REG002"]
+
+
+def test_trend_exact_count_increase_is_reg003_immediately():
+    # counts need no rolling window: 2 entries suffice, any increase fires
+    entries = _history_entries(
+        [2, 3], metric="dp/count/inventory/all-reduce/f32/data/g4")
+    findings = trend_findings(entries)
+    assert [f.rule for f in findings] == ["REG003"]
+    # a DECREASE is an improvement, not a finding
+    assert trend_findings(_history_entries(
+        [3, 2], metric="dp/count/inventory/all-reduce/f32/data/g4")) == []
+
+
+def test_trend_exact_count_first_appearance_is_reg003():
+    # union-of-keys semantics, like bench compare: a count metric's
+    # FIRST appearance (fresh badput category, first lint finding, new
+    # inventory key) is 0 -> N drift, not a silent new series
+    entries = _history_entries([1.0, 1.0], metric="goodput/quality/"
+                                                  "goodput_fraction")
+    entries[1].metrics["goodput/count/badput/restart_gap"] = 1.0
+    findings = trend_findings(entries)
+    assert [f.rule for f in findings] == ["REG003"]
+    assert findings[0].metric == "goodput/count/badput/restart_gap"
+    assert findings[0].baseline == 0.0
+    # but only within the same artifact kind: a goodput entry genuinely
+    # has no inventory counts, so an analyze entry's counts must not
+    # read as 0 -> N against it
+    entries = _history_entries([1.0, 1.0],
+                               metric="dp/count/inventory/all-reduce")
+    entries[0].artifact_kind = "goodput_ledger"
+    entries[0].metrics = {"goodput/quality/goodput_fraction": 0.9}
+    assert trend_findings(entries) == []
+
+
+def test_trend_dirty_drift_adds_reg004():
+    findings = trend_findings(
+        _history_entries(CLEAN_HISTORY + [8100], dirty=True))
+    assert sorted(f.rule for f in findings) == ["REG001", "REG004"]
+
+
+def test_trend_series_isolated_by_digest_and_chip():
+    # same metric, different config digests: windows must not mix
+    a = _history_entries(CLEAN_HISTORY, digest="cfgA000000")
+    b = _history_entries([100.0], digest="cfgB000000")
+    assert trend_findings(a + b) == []
+
+
+def test_trend_respects_min_history():
+    entries = _history_entries([9000, 9000, 8100])
+    assert trend_findings(entries, TrendConfig(min_history=4)) == []
+
+
+# -- auto-baseline ----------------------------------------------------------
+
+def test_select_baseline_newest_clean_match():
+    entries = _history_entries(CLEAN_HISTORY)
+    entry, refusal = select_baseline(
+        entries, config_digest="cfgAAAAAAA", device_kind="TPU v5 lite")
+    assert refusal is None
+    assert entry.entry_id == entries[-1].entry_id
+
+
+def test_select_baseline_named_refusals():
+    entries = _history_entries(CLEAN_HISTORY)
+    _, r = select_baseline([], config_digest="x", device_kind="cpu")
+    assert "empty" in r
+    _, r = select_baseline(entries, config_digest=None,
+                           device_kind="cpu")
+    assert "no config digest" in r
+    _, r = select_baseline(entries, config_digest="nomatch000",
+                           device_kind="TPU v5 lite")
+    assert "no entry matches config digest nomatch000" in r
+    assert "cfgAAAAAAA" in r  # the refusal lists what IS there
+    _, r = select_baseline(entries, config_digest="cfgAAAAAAA",
+                           device_kind="TPU v6e")
+    assert "none on device kind 'TPU v6e'" in r
+    _, r = select_baseline(entries, config_digest="cfgAAAAAAA",
+                           device_kind="TPU v5 lite",
+                           artifact_kind="analyze")
+    assert "none is a 'analyze' artifact" in r
+
+
+def test_select_baseline_skips_dirty_unless_allowed():
+    entries = _history_entries(CLEAN_HISTORY, dirty=True)
+    entry, r = select_baseline(entries, config_digest="cfgAAAAAAA",
+                               device_kind="TPU v5 lite")
+    assert entry is None and "clean git checkout" in r
+    entry, r = select_baseline(entries, config_digest="cfgAAAAAAA",
+                               device_kind="TPU v5 lite",
+                               allow_dirty=True)
+    assert entry is not None and r is None
+
+
+def test_derived_digests_separate_unrelated_bare_artifacts(tmp_path):
+    # two provenance-less bare records measuring different things must
+    # not collapse into one series/baseline pool
+    a = _write(tmp_path, "a.json",
+               {"metric": "resnet_throughput", "value": 9000.0})
+    b = _write(tmp_path, "b.json",
+               {"metric": "bert_throughput", "value": 12.0})
+    da, _, _ = candidate_identity(a)
+    db, _, _ = candidate_identity(b)
+    assert da != db
+    # while a re-capture of the SAME thing keys identically
+    a2 = _write(tmp_path, "a2.json",
+                {"metric": "resnet_throughput", "value": 9100.0})
+    assert candidate_identity(a2)[0] == da
+
+
+def test_candidate_identity_matches_record_derivation(tmp_path):
+    path = _write(tmp_path, "a.json", _analyze_artifact())
+    digest, chip, kind = candidate_identity(path)
+    entry = record_artifact(str(tmp_path / "reg"), path)
+    assert (digest, chip, kind) == (entry.config_digest,
+                                    entry.device_kind,
+                                    entry.artifact_kind)
+
+
+def test_find_entry_by_prefix_and_index(tmp_path):
+    reg = str(tmp_path / "reg")
+    for i in range(3):
+        record_artifact(
+            reg, _write(tmp_path, f"a{i}.json", _bench_artifact(1000 + i)),
+            now=1000.0 + i)
+    entries = read_entries(reg)
+    assert find_entry(entries, "#0") is entries[0]
+    assert find_entry(entries, "#-1") is entries[-1]
+    assert find_entry(entries, entries[1].entry_id[:6]) is entries[1]
+    assert find_entry(entries, "zzzz") is None
+    assert find_entry(entries, "#9") is None
+
+
+# -- bench compare --against ------------------------------------------------
+
+def test_compare_against_auto_baseline_pass_and_fail(tmp_path, capsys):
+    from tpu_ddp.analysis.regress import main as compare_main
+
+    reg = str(tmp_path / "reg")
+    base = _write(tmp_path, "base.json", _analyze_artifact())
+    record_artifact(reg, base)
+    cand_ok = _write(tmp_path, "cand.json", _analyze_artifact())
+    assert compare_main(["--against", reg, cand_ok]) == 0
+    assert "no regressions" in capsys.readouterr().out
+    cand_bad = _write(tmp_path, "cand_bad.json",
+                      _analyze_artifact(extra_collective=True))
+    assert compare_main(["--against", reg, cand_bad]) == 1
+    assert "extra collective" in capsys.readouterr().out
+
+
+def test_compare_against_refuses_with_named_reason(tmp_path, capsys):
+    from tpu_ddp.analysis.regress import main as compare_main
+
+    reg = str(tmp_path / "reg")
+    record_artifact(reg, _write(tmp_path, "base.json",
+                                _analyze_artifact()))
+    stranger = _write(tmp_path, "stranger.json",
+                      _bench_artifact(digest="nomatch000"))
+    assert compare_main(["--against", reg, stranger]) == 2
+    out = capsys.readouterr().out
+    assert "no baseline auto-selected" in out
+    assert "no entry matches config digest" in out
+
+
+def test_compare_against_takes_exactly_one_candidate(tmp_path, capsys):
+    from tpu_ddp.analysis.regress import main as compare_main
+
+    a = _write(tmp_path, "a.json", _bench_artifact())
+    assert compare_main(["--against", str(tmp_path), a, a]) == 2
+    assert "exactly one candidate" in capsys.readouterr().out
+
+
+def test_compare_two_file_path_unchanged(tmp_path, capsys):
+    from tpu_ddp.analysis.regress import main as compare_main
+
+    a = _write(tmp_path, "a.json", _analyze_artifact())
+    b = _write(tmp_path, "b.json", _analyze_artifact(
+        extra_collective=True))
+    assert compare_main([a, a]) == 0
+    assert compare_main([a, b]) == 1
+    assert compare_main([a]) == 2  # one path without --against
+
+
+# -- registry diff parity ---------------------------------------------------
+
+def test_registry_diff_parity_with_bench_compare(tmp_path, capsys):
+    from tpu_ddp.analysis.regress import main as compare_main
+    from tpu_ddp.registry.cli import main as registry_main
+
+    reg = str(tmp_path / "reg")
+    old = _write(tmp_path, "old.json", _analyze_artifact())
+    new = _write(tmp_path, "new.json",
+                 _analyze_artifact(extra_collective=True))
+    record_artifact(reg, old, now=1000.0)
+    record_artifact(reg, new, now=1001.0)
+
+    rc_files = compare_main([old, new])
+    files_out = capsys.readouterr().out
+    rc_reg = registry_main(["--registry", reg, "diff", "#0", "#1"])
+    reg_out = capsys.readouterr().out
+    assert rc_files == rc_reg == 1
+    # the SAME regression line, modulo the artifact labels
+    assert "extra collective" in files_out
+    assert "extra collective" in reg_out
+    assert registry_main(["--registry", reg, "diff", "#0", "#0"]) == 0
+    capsys.readouterr()
+    assert registry_main(["--registry", reg, "diff", "#0", "zzz"]) == 2
+
+
+# -- CLI --json schemas -----------------------------------------------------
+
+def test_cli_list_and_trend_json_schemas(tmp_path, capsys):
+    from tpu_ddp.registry.cli import main as registry_main
+
+    reg = str(tmp_path / "reg")
+    for i, v in enumerate(CLEAN_HISTORY + [8100]):
+        record_artifact(
+            reg, _write(tmp_path, f"h{i}.json",
+                        _bench_artifact(float(v), commit=f"{i:040x}")),
+            now=1000.0 + i)
+
+    assert registry_main(["--registry", reg, "list", "--json"]) == 0
+    listing = json.loads(capsys.readouterr().out)
+    assert listing["registry"] == reg
+    assert len(listing["entries"]) == 9
+    first = listing["entries"][0]
+    for key in ("entry_id", "recorded_at", "artifact_kind",
+                "config_digest", "device_kind", "git_commit",
+                "git_dirty", "n_metrics"):
+        assert key in first
+
+    assert registry_main(["--registry", reg, "trend", "--json"]) == 1
+    trend = json.loads(capsys.readouterr().out)
+    rules = {f["rule"] for f in trend["findings"]}
+    assert rules == {"REG001"}
+    f = trend["findings"][0]
+    for key in ("rule", "severity", "metric", "config_digest",
+                "device_kind", "entry_id", "git_commit", "title", "fix"):
+        assert key in f
+
+    # metric filter narrows; a filter matching nothing exits clean
+    assert registry_main(["--registry", reg, "trend", "--json",
+                          "--metric", "no_such_metric"]) == 0
+    assert json.loads(capsys.readouterr().out)["findings"] == []
+
+
+def test_cli_record_show_round_trip(tmp_path, capsys):
+    from tpu_ddp.registry.cli import main as registry_main
+
+    reg = str(tmp_path / "reg")
+    path = _write(tmp_path, "a.json", _bench_artifact())
+    assert registry_main(["--registry", reg, "record", path,
+                          "--note", "hello"]) == 0
+    capsys.readouterr()
+    assert registry_main(["--registry", reg, "show", "#0"]) == 0
+    shown = json.loads(capsys.readouterr().out)
+    assert shown["type"] == "registry_entry"
+    assert shown["note"] == "hello"
+    assert shown["provenance"]["git_commit"] == CLEAN_COMMIT
+    assert registry_main(["--registry", reg, "show", "nope"]) == 2
+
+
+def test_cli_record_refuses_unreadable(tmp_path, capsys):
+    from tpu_ddp.registry.cli import main as registry_main
+
+    assert registry_main(["--registry", str(tmp_path / "reg"),
+                          "record", str(tmp_path / "missing.json")]) == 2
+
+
+def test_cli_future_schema_is_usage_error_not_finding(tmp_path, capsys):
+    # a future-schema refusal must exit 2 everywhere — `trend`'s exit 1
+    # is reserved for drift findings, and CI keys on that
+    from tpu_ddp.registry.cli import main as registry_main
+
+    reg = tmp_path / "reg"
+    reg.mkdir()
+    (reg / "registry.jsonl").write_text(json.dumps(
+        {"registry_schema_version": 99, "type": "registry_entry"}) + "\n")
+    for sub in (["list"], ["trend"], ["show", "#0"], ["diff", "#0", "#1"]):
+        assert registry_main(["--registry", str(reg), *sub]) == 2, sub
+        assert "newer" in capsys.readouterr().err
+
+
+def test_umbrella_cli_routes_registry(tmp_path, capsys):
+    from tpu_ddp.cli.main import main as cli_main
+
+    reg = str(tmp_path / "reg")
+    path = _write(tmp_path, "a.json", _bench_artifact())
+    assert cli_main(["registry", "--registry", reg, "record", path]) == 0
+    assert cli_main(["registry", "--registry", reg, "list"]) == 0
+    assert "bench" in capsys.readouterr().out
+
+
+# -- trace summarize --json -------------------------------------------------
+
+def _synthetic_trace(tmp_path):
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    records = [
+        {"schema_version": 1, "type": "header",
+         "run_meta": {"run_meta_schema_version": 1,
+                      "run_id": "runsynth01", "strategy": "dp",
+                      "device_kind": "cpu", "jax_version": "0.0-test",
+                      "git_commit": CLEAN_COMMIT, "git_dirty": False}},
+    ]
+    for step in range(5):
+        records.append({"schema_version": 1, "type": "span",
+                        "name": "compiled_step", "ts_s": 0.1 * step,
+                        "dur_s": 0.02, "pid": 0, "tid": 1, "depth": 0,
+                        "step": step})
+    records.append({"schema_version": 1, "type": "counters",
+                    "name": "counters", "ts_s": 1.0, "pid": 0, "tid": 1,
+                    "step": 4,
+                    "attrs": {"counters": {"train/steps": 5},
+                              "gauges": {}}})
+    with open(run_dir / "trace-p0.jsonl", "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return run_dir
+
+
+def test_trace_summarize_json_schema(tmp_path, capsys):
+    from tpu_ddp.cli.main import main as cli_main
+
+    run_dir = _synthetic_trace(tmp_path)
+    assert cli_main(["trace", "summarize", str(run_dir), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["type"] == "trace_summary"
+    assert out["trace_summary_schema_version"] == 1
+    assert out["run_meta"]["run_id"] == "runsynth01"
+    ph = out["phases"]["compiled_step"]
+    assert ph["count"] == 5
+    assert ph["p50_s"] == pytest.approx(0.02)
+    assert out["counters"]["0"]["values"]["train/steps"] == 5
+    # provenance rides along: the run's id IS the config digest
+    assert out["provenance"]["config_digest"] == "runsynth01"
+
+
+def test_trace_summary_recordable_and_compare_noted(tmp_path, capsys):
+    from tpu_ddp.analysis.regress import main as compare_main
+    from tpu_ddp.cli.main import main as cli_main
+
+    run_dir = _synthetic_trace(tmp_path)
+    assert cli_main(["trace", "summarize", str(run_dir), "--json"]) == 0
+    path = _write(tmp_path, "summary.json",
+                  json.loads(capsys.readouterr().out))
+    entry = record_artifact(str(tmp_path / "reg"), path)
+    assert entry.artifact_kind == "trace_summary"
+    assert entry.config_digest == "runsynth01"
+    assert entry.metrics[
+        "trace_summary/wall/phase/compiled_step_p50_s"] == pytest.approx(
+        0.02)
+    # wall-clock summaries never GATE a compare (machine-speed noise):
+    # self-compare is clean by construction
+    assert compare_main([path, path]) == 0
+
+
+# -- run_meta provenance at the source --------------------------------------
+
+def test_trainer_run_meta_carries_git_identity(devices, tmp_path):
+    from tpu_ddp.train.trainer import TrainConfig, Trainer
+
+    cfg = TrainConfig(
+        synthetic_data=True, synthetic_size=64, per_shard_batch=8,
+        epochs=1, n_chans1=4, n_blocks=1, n_devices=4,
+        telemetry_dir=str(tmp_path / "run"), telemetry_sinks="jsonl",
+    )
+    trainer = Trainer(cfg)
+    try:
+        meta = trainer.run_meta
+        assert meta["git_commit"] == git_provenance()["git_commit"]
+        assert meta["git_dirty"] == git_provenance()["git_dirty"]
+        # and the run_id still follows the shared digest recipe
+        import dataclasses
+
+        assert meta["run_id"] == config_digest(dataclasses.asdict(cfg))
+    finally:
+        trainer.close()

@@ -704,17 +704,41 @@ def _analyze_run_dir(args) -> int:
     rl = roofline(anatomy, args.chip, overlap=args.overlap)
     fp = check_fingerprint(anatomy)
     joined = join_measurements(anatomy, rl, args.path, chip=args.chip)
-    _emit(args, anatomy, rl, fp, joined)
+    _emit(args, anatomy, rl, fp, joined, run_meta=meta)
     return 0 if (fp.get("ok") is not False) else 1
 
 
-def _emit(args, anatomy, rl, fp, joined=None) -> None:
+def _provenance_for(anatomy, run_meta=None) -> dict:
+    """The artifact provenance header (git commit/dirty + config
+    digest): the run's deterministic ``run_id`` when analyzing a run
+    dir, else a digest of what was compiled — so re-analyses of the
+    same program land in the same perf-registry series across
+    commits."""
+    import jax
+
+    from tpu_ddp.telemetry.provenance import artifact_provenance
+
+    return artifact_provenance(
+        run_id=(run_meta or {}).get("run_id"),
+        descriptor={"artifact": "analyze", "strategy": anatomy.strategy,
+                    "model": anatomy.model, "mesh": anatomy.mesh},
+        device_kind=anatomy.device_kind,
+        jax_version=jax.__version__,
+        strategy=anatomy.strategy,
+        mesh=anatomy.mesh,
+    )
+
+
+def _emit(args, anatomy, rl, fp, joined=None, run_meta=None) -> None:
     if getattr(args, "json", None):
         payload = {
             "anatomy": anatomy.to_json(),
             "roofline": rl.to_json(),
             "fingerprint": fp,
+            "provenance": _provenance_for(anatomy, run_meta),
         }
+        if run_meta is not None:
+            payload["run_meta"] = run_meta
         if joined is not None:
             payload["measured"] = joined
         with open(args.json, "w") as f:
@@ -755,8 +779,21 @@ def _analyze_static(args) -> int:
         if fp.get("ok") is False:
             rc = 1
     if programs and getattr(args, "json", None):
+        import jax
+
+        from tpu_ddp.telemetry.provenance import artifact_provenance
+
         with open(args.json, "w") as f:
-            json.dump({"programs": programs}, f, indent=1)
+            json.dump({
+                "programs": programs,
+                "provenance": artifact_provenance(
+                    descriptor={"artifact": "analyze-all",
+                                "strategies": sorted(programs),
+                                "model": args.model,
+                                "compute_dtype": args.compute_dtype},
+                    jax_version=jax.__version__,
+                ),
+            }, f, indent=1)
         print(f"tpu-ddp analyze: wrote {args.json} "
               f"({len(programs)} programs)", flush=True)
     return rc

@@ -930,9 +930,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"tpu-ddp lint: {e}", flush=True)
         return 2
     if args.json:
+        from tpu_ddp.telemetry.provenance import artifact_provenance
+
         with open(args.json, "w") as f:
-            json.dump({"lint_schema_version": LINT_SCHEMA_VERSION,
-                       "programs": programs}, f, indent=1)
+            json.dump({
+                "lint_schema_version": LINT_SCHEMA_VERSION,
+                "programs": programs,
+                # commit identity + a stable series key, so archived
+                # lint artifacts trend per config across commits
+                "provenance": artifact_provenance(
+                    descriptor={"artifact": "lint",
+                                "strategies": sorted(programs),
+                                "compute_dtype": args.compute_dtype},
+                ),
+            }, f, indent=1)
         print(f"tpu-ddp lint: wrote {args.json} "
               f"({len(programs)} programs)", flush=True)
     if n_errors:

@@ -17,13 +17,20 @@ fusion choices and temp bytes is real). Wall-clock fields
 (``compile_wall_s``) are reported, never gated — they measure the build
 machine, not the program.
 
-Understands four artifact shapes: ``benchmarks/aot_v5e.json``-style
+Understands five artifact shapes: ``benchmarks/aot_v5e.json``-style
 (``{"programs": {name: record}}``), ``tpu-ddp analyze --json`` output
 (``{"anatomy": ...}``), ``tpu-ddp goodput --json`` ledgers
 (``{"ledger": ...}`` — badput category presence gates exactly, the
-goodput fraction with tolerance, wall clock is reported only), and a
+goodput fraction with tolerance, wall clock is reported only),
+``tpu-ddp trace summarize --json`` run summaries (measured phase
+percentiles: report-only here, trend-gated by the registry), and a
 bare single program record. Stdlib-only — no jax import — so it gates
 anywhere the JSON lands.
+
+``--against <registry-dir>`` replaces the hand-pointed baseline file
+with auto-selection from the perf registry (docs/registry.md): the
+newest clean entry matching the candidate's config digest + device
+kind, refusing with a named reason (exit 2) when none matches.
 """
 
 from __future__ import annotations
@@ -68,6 +75,14 @@ def load_artifact(path: str) -> Dict[str, dict]:
     """Normalize an artifact file into ``{program_name: record}``."""
     with open(path) as f:
         art = json.load(f)
+    return normalize_artifact(art, path)
+
+
+def normalize_artifact(art, path: str = "<artifact>") -> Dict[str, dict]:
+    """The shape rules behind :func:`load_artifact`, on an
+    already-parsed document — callers that need both the raw artifact
+    and its normalization (the perf registry) parse the file once and
+    route through here."""
     if not isinstance(art, dict):
         raise ValueError(f"{path}: expected a JSON object artifact")
     if isinstance(art.get("programs"), dict):
@@ -81,6 +96,13 @@ def load_artifact(path: str) -> Dict[str, dict]:
         # fresh restart_gap category = the benched run started failing),
         # goodput_fraction gates with tolerance, wall clock is noted
         return {"goodput": art["ledger"]}
+    if art.get("type") == "trace_summary" and isinstance(
+            art.get("phases"), dict):
+        # `tpu-ddp trace summarize --json`: measured per-phase
+        # percentiles. Nothing here is compare-gateable (wall clock
+        # measures the machine), but the registry records it and trends
+        # the phase p50s per (config, chip) series across commits.
+        return {"trace_summary": art}
     return {"program": art}
 
 
@@ -309,29 +331,82 @@ def render(result: dict, old_path: str, new_path: str) -> str:
     return "\n".join(lines)
 
 
+def _baseline_from_registry(registry_dir: str, candidate_path: str,
+                            allow_dirty: bool):
+    """(programs, label) of the auto-selected baseline, or raises
+    ``ValueError`` with the named refusal. Lazy import keeps the plain
+    two-file compare path exactly as import-light as before."""
+    from tpu_ddp.registry.store import (
+        candidate_identity,
+        default_registry_dir,
+        read_entries,
+        select_baseline,
+    )
+
+    registry_dir = default_registry_dir(registry_dir)
+    digest, device_kind, kind = candidate_identity(candidate_path)
+    entry, refusal = select_baseline(
+        read_entries(registry_dir),
+        config_digest=digest, device_kind=device_kind,
+        artifact_kind=kind, allow_dirty=allow_dirty,
+    )
+    if entry is None:
+        raise ValueError(
+            f"--against {registry_dir}: no baseline auto-selected: "
+            f"{refusal}")
+    return entry.programs, f"{registry_dir}:{entry.entry_id}"
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    """``tpu-ddp bench compare old.json new.json [--tolerance 0.05]``"""
+    """``tpu-ddp bench compare old.json new.json [--tolerance 0.05]``
+    or ``tpu-ddp bench compare --against <registry-dir> new.json``."""
     import argparse
 
     ap = argparse.ArgumentParser(
         prog="tpu-ddp bench compare",
         description="structured diff of two bench/AOT/analyze artifacts; "
                     "exits 1 on any regression (extra collectives, "
-                    "widened payload dtypes, memory/flops growth)",
+                    "widened payload dtypes, memory/flops growth). With "
+                    "--against, the baseline is auto-selected from a "
+                    "perf registry instead of hand-pointed",
     )
-    ap.add_argument("old", help="baseline artifact (the committed JSON)")
-    ap.add_argument("new", help="freshly captured artifact")
+    ap.add_argument("paths", nargs="+", metavar="artifact.json",
+                    help="baseline and candidate artifacts — or just "
+                         "the candidate when --against picks the "
+                         "baseline from the registry")
+    ap.add_argument("--against", default=None, metavar="REGISTRY_DIR",
+                    help="auto-select the baseline: newest clean "
+                         "registry entry matching the candidate's "
+                         "config digest + device kind (exit 2 with a "
+                         "named reason when none matches)")
+    ap.add_argument("--allow-dirty", action="store_true",
+                    help="with --against: accept a baseline recorded "
+                         "from a dirty working tree")
     ap.add_argument("--tolerance", type=float, default=0.05,
                     help="relative growth allowed on sized metrics and "
                          "compiler-decision counts (default 0.05); "
                          "collective counts always compare exactly")
     args = ap.parse_args(list(argv) if argv is not None else None)
     try:
-        old = load_artifact(args.old)
-        new = load_artifact(args.new)
+        if args.against:
+            if len(args.paths) != 1:
+                raise ValueError(
+                    "--against takes exactly one candidate artifact "
+                    f"(got {len(args.paths)} paths)")
+            new_path = args.paths[0]
+            old, old_label = _baseline_from_registry(
+                args.against, new_path, args.allow_dirty)
+        else:
+            if len(args.paths) != 2:
+                raise ValueError(
+                    "expected exactly two artifacts: old.json new.json "
+                    "(or --against <registry-dir> new.json)")
+            old_label, new_path = args.paths
+            old = load_artifact(old_label)
+        new = load_artifact(new_path)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"tpu-ddp bench compare: {e}", flush=True)
         return 2
     result = compare(old, new, tolerance=args.tolerance)
-    print(render(result, args.old, args.new), flush=True)
+    print(render(result, old_label, new_path), flush=True)
     return 1 if result["regressions"] else 0

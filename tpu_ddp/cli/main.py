@@ -41,10 +41,16 @@ Subcommands:
 - ``tpu-ddp bench compare old.json new.json`` — structured diff of two
   bench/AOT/analyze/lint artifacts; exits 1 on regressions (extra
   collectives, widened payload dtypes, memory/flops growth, new lint
-  findings).
+  findings). ``--against <registry>`` auto-selects the baseline from
+  the perf registry instead of a hand-pointed file.
+- ``tpu-ddp registry record|list|show|trend|diff`` — the cross-run
+  perf results archive: append-only provenance-stamped store of every
+  artifact family, REG-rule drift detection over per-(metric × config
+  × chip) series, and entry-vs-entry diffs with the exact ``bench
+  compare`` gating semantics (docs/registry.md).
 
 ``trace summarize``, ``health``, ``watch``, ``profile`` (modulo its
-lazy per-op join), and ``bench compare`` are stdlib-only
+lazy per-op join), ``registry``, and ``bench compare`` are stdlib-only
 end to end (no jax import): records are summarized wherever they land —
 a laptop, a CI box, the pod host itself. The train/launch/analyze
 subcommands import lazily so the read-back commands keep that property.
@@ -58,10 +64,15 @@ from typing import Optional, Sequence
 
 
 def _trace_summarize(args) -> int:
-    from tpu_ddp.telemetry.summarize import summarize
+    from tpu_ddp.telemetry.summarize import summarize, summarize_json
 
     try:
-        print(summarize(args.path))
+        if getattr(args, "json", False):
+            import json as _json
+
+            print(_json.dumps(summarize_json(args.path), indent=1))
+        else:
+            print(summarize(args.path))
     except (FileNotFoundError, ValueError) as e:
         print(f"tpu-ddp trace summarize: {e}", file=sys.stderr)
         return 2
@@ -119,6 +130,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_ddp.ledger.report import main as goodput_main
 
         return goodput_main(argv[1:])
+    # registry is stdlib-only too (record/list/show/trend/diff)
+    if argv[:1] == ["registry"]:
+        from tpu_ddp.registry.cli import main as registry_main
+
+        return registry_main(argv[1:])
     if argv[:2] == ["bench", "compare"]:
         from tpu_ddp.analysis.regress import main as compare_main
 
@@ -140,6 +156,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     summ.add_argument("path", help="run dir (holding trace-p*.jsonl) or a "
                                    "trace file")
+    summ.add_argument("--json", action="store_true",
+                      help="emit the schema-versioned machine summary "
+                           "(perf-registry-recordable)")
     summ.set_defaults(func=_trace_summarize)
     health = sub.add_parser(
         "health",
@@ -166,6 +185,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="cross-incarnation goodput/badput ledger + Young–Daly "
              "checkpoint-interval advisor over a run dir "
              "(tpu-ddp goodput --help)",
+    )
+    sub.add_parser(
+        "registry",
+        help="cross-run perf results archive: record artifacts with "
+             "provenance, trend-detect drift, diff entries "
+             "(tpu-ddp registry --help)",
     )
     sub.add_parser(
         "analyze",

@@ -36,6 +36,10 @@ def ledger_json(ledger: RunLedger) -> dict:
             "run_dir": ledger.run_dir,
             "run_id": ledger.run_id,
             "strategy": ledger.strategy,
+            "device_kind": ledger.device_kind,
+            "jax_version": ledger.jax_version,
+            "git_commit": ledger.git_commit,
+            "git_dirty": ledger.git_dirty,
             "elapsed_s": ledger.elapsed_s,
             "goodput_fraction": ledger.goodput_fraction,
             "category_seconds": dict(ledger.categories),

@@ -113,6 +113,13 @@ class RunLedger:
     checkpoint_count: int
     recommendation: Optional[dict]
     notes: List[str]
+    # run identity carried from the metadata header so the --json
+    # artifact is perf-registry-recordable with full provenance
+    # (device series + commit to bisect from; docs/registry.md)
+    device_kind: Optional[str] = None
+    jax_version: Optional[str] = None
+    git_commit: Optional[str] = None
+    git_dirty: Optional[bool] = None
 
     @property
     def category_presence(self) -> Dict[str, int]:
@@ -285,4 +292,8 @@ def build_ledger(run: StitchedRun) -> RunLedger:
         checkpoint_count=len(ckpt_walls),
         recommendation=recommendation,
         notes=notes,
+        device_kind=meta.get("device_kind"),
+        jax_version=meta.get("jax_version"),
+        git_commit=meta.get("git_commit"),
+        git_dirty=meta.get("git_dirty"),
     )

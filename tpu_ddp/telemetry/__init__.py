@@ -27,6 +27,12 @@ from tpu_ddp.telemetry.events import (
     Clock,
     Event,
 )
+from tpu_ddp.telemetry.provenance import (
+    PROVENANCE_SCHEMA_VERSION,
+    artifact_provenance,
+    config_digest,
+    git_provenance,
+)
 from tpu_ddp.telemetry.registry import (
     Registry,
     default_registry,
@@ -169,6 +175,10 @@ __all__ = [
     "Event",
     "SCHEMA_VERSION",
     "RUN_META_SCHEMA_VERSION",
+    "PROVENANCE_SCHEMA_VERSION",
+    "artifact_provenance",
+    "config_digest",
+    "git_provenance",
     "Registry",
     "default_registry",
     "reset_default_registry",

@@ -1,0 +1,126 @@
+"""Provenance stamping: git identity + deterministic config digests.
+
+Every durable artifact the framework emits — the run-metadata header the
+sinks write, ``bench.py``/``benchmarks/aot_v5e.py`` captures, ``tpu-ddp
+analyze/lint --json`` — should be able to say WHICH commit produced it
+and which logical configuration it measured, because the perf registry
+(``tpu_ddp/registry``) archives those artifacts across runs and commits
+and nothing downstream can re-derive that identity after the fact.
+
+Three pieces, all stdlib-only (the launcher and the read-back CLIs must
+never pull in jax):
+
+- :func:`git_provenance` — subprocess probe of the working tree
+  (``git rev-parse HEAD`` + ``git status --porcelain``). Graceful
+  ``None``/``None`` outside a repo or without a git binary: artifacts
+  produced on a bare deployment still record, they just carry no commit
+  identity (and the registry's trend rules note it).
+- :func:`config_digest` — the PR 7 deterministic run-id recipe
+  (sha1 of the sort-keyed JSON, first 10 hex chars) exposed as THE one
+  digest function, so the Trainer's ``run_id``, bench/AOT artifact
+  digests, and the registry's baseline matching all share one identity
+  space instead of three hand-rolled hashes.
+- :func:`artifact_provenance` — the header dict the capture tools embed
+  (``git_commit``/``git_dirty``, ``config_digest``, device kind, jax
+  version, strategy/mesh when known, schema version).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+import subprocess
+from typing import Any, Dict, Optional
+
+#: bump on any breaking change to the provenance header shape
+PROVENANCE_SCHEMA_VERSION = 1
+
+_GIT_TIMEOUT_S = 5.0
+
+
+@functools.lru_cache(maxsize=16)
+def _git_probe(cwd: Optional[str]) -> tuple:
+    """(commit, dirty) for the repo containing ``cwd`` — cached per
+    process (the probe is two subprocesses; Trainer init and every
+    artifact writer call this). ``(None, None)`` outside a repo or
+    without git; a dirty probe that fails after the commit succeeded
+    reports ``dirty=None`` (unknown), never a guess."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=cwd, capture_output=True, text=True,
+            timeout=_GIT_TIMEOUT_S,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None, None
+    if out.returncode != 0:
+        return None, None
+    commit = out.stdout.strip() or None
+    if commit is None:
+        return None, None
+    try:
+        st = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=cwd, capture_output=True, text=True,
+            timeout=_GIT_TIMEOUT_S,
+        )
+        dirty = bool(st.stdout.strip()) if st.returncode == 0 else None
+    except (OSError, subprocess.SubprocessError):
+        dirty = None
+    return commit, dirty
+
+
+def git_provenance(cwd: Optional[str] = None) -> Dict[str, Any]:
+    """``{"git_commit": <40-hex or None>, "git_dirty": bool or None}``
+    for the repository containing ``cwd`` (default: the process cwd)."""
+    commit, dirty = _git_probe(cwd)
+    return {"git_commit": commit, "git_dirty": dirty}
+
+
+def config_digest(obj: Any) -> str:
+    """Deterministic 10-hex digest of a JSON-serializable config — the
+    exact recipe the Trainer has stamped as ``run_id`` since PR 7, so
+    the same config yields the same digest on every host (and every
+    commit) with no coordination."""
+    return hashlib.sha1(
+        json.dumps(obj, sort_keys=True, default=str).encode()
+    ).hexdigest()[:10]
+
+
+def artifact_provenance(
+    *,
+    descriptor: Any = None,
+    run_id: Optional[str] = None,
+    device_kind: Optional[str] = None,
+    jax_version: Optional[str] = None,
+    strategy: Optional[str] = None,
+    mesh: Optional[dict] = None,
+    cwd: Optional[str] = None,
+) -> Dict[str, Any]:
+    """The provenance header an artifact writer embeds.
+
+    ``config_digest`` is ``run_id`` when the artifact came from a run
+    (the Trainer's deterministic config digest IS its identity),
+    otherwise the digest of ``descriptor`` — a small stable dict naming
+    what was measured (e.g. ``{"artifact": "aot_v5e", "topology":
+    "v5e:2x4"}``), so re-captures of the same thing land in the same
+    registry series across commits.
+    """
+    prov: Dict[str, Any] = {
+        "provenance_schema_version": PROVENANCE_SCHEMA_VERSION,
+        **git_provenance(cwd),
+        "config_digest": run_id if run_id else (
+            config_digest(descriptor) if descriptor is not None else None),
+    }
+    if run_id:
+        prov["run_id"] = run_id
+    if device_kind is not None:
+        prov["device_kind"] = device_kind
+    if jax_version is not None:
+        prov["jax_version"] = jax_version
+    if strategy is not None:
+        prov["strategy"] = strategy
+    if mesh is not None:
+        prov["mesh"] = dict(mesh)
+    return prov

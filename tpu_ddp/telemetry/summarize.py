@@ -3,7 +3,10 @@
 Backs ``tpu-ddp trace summarize <run_dir>``: reads the schema-versioned
 JSONL trace(s) a run wrote (``trace-p*.jsonl``), buckets span durations by
 phase name, and renders the same table the terminal summary sink prints
-live. Stdlib-only so it runs anywhere the trace files land.
+live. ``--json`` emits the same aggregation as a schema-versioned
+machine artifact (:func:`summarize_json`) so run summaries are
+perf-registry-recordable like every other artifact instead of being
+terminal-only. Stdlib-only so it runs anywhere the trace files land.
 """
 
 from __future__ import annotations
@@ -16,6 +19,9 @@ from typing import Dict, Iterable, List, Optional
 from tpu_ddp.telemetry.events import SCHEMA_VERSION, SPAN
 from tpu_ddp.telemetry.registry import Histogram
 from tpu_ddp.telemetry.sinks import format_phase_table
+
+#: bump on any breaking change to the ``trace summarize --json`` shape
+TRACE_SUMMARY_SCHEMA_VERSION = 1
 
 
 def find_trace_files(path: str) -> List[str]:
@@ -114,6 +120,16 @@ def per_host_phase_p50(records: Iterable[dict],
             by_host.setdefault(rec.get("pid", 0), Histogram()).record(dur)
     return {pid: h.percentile(50) for pid, h in by_host.items()
             if h.count}
+
+
+def find_run_meta(records: Iterable[dict]) -> Optional[dict]:
+    """The raw run-metadata header dict the sinks wrote (first header
+    record wins); None for anonymous (pre-header) traces."""
+    for rec in records:
+        if rec.get("type") == "header" and isinstance(
+                rec.get("run_meta"), dict):
+            return rec["run_meta"]
+    return None
 
 
 def run_label(records: Iterable[dict]) -> Optional[str]:
@@ -245,3 +261,57 @@ def summarize(path: str) -> str:
             lines.append("")
             lines.extend(profiler)
     return "\n".join(lines)
+
+
+def summarize_json(path: str) -> dict:
+    """Machine-readable twin of :func:`summarize`: the per-phase
+    percentile table, the newest per-host counters/gauges, and the run
+    identity (header run_meta + a provenance stamp), schema-versioned so
+    the perf registry can record a run summary like any other artifact.
+    Phase seconds are MEASURED wall clock — ``bench compare`` keeps them
+    report-only, while ``tpu-ddp registry trend`` series them per
+    (config digest, chip) across commits, where same-chip drift is
+    exactly the signal."""
+    from tpu_ddp.telemetry.provenance import artifact_provenance
+
+    files = find_trace_files(path)
+    records = read_records(files)
+    phases = aggregate_phases(records)
+    meta = find_run_meta(records)
+    counters: Dict[str, dict] = {}
+    for pid, snap in last_counters(records).items():
+        flat = dict(snap.get("counters", {}))
+        flat.update(snap.get("gauges", {}))
+        counters[str(pid)] = {
+            "step": snap.get("_step"),
+            "snapshot_kind": snap.get("_name"),
+            "values": flat,
+        }
+    meta = meta or {}
+    return {
+        "trace_summary_schema_version": TRACE_SUMMARY_SCHEMA_VERSION,
+        "type": "trace_summary",
+        "files": [os.path.basename(f) for f in files],
+        "run_meta": meta or None,
+        "provenance": artifact_provenance(
+            run_id=meta.get("run_id"),
+            descriptor={"artifact": "trace_summary",
+                        "strategy": meta.get("strategy"),
+                        "mesh": meta.get("mesh")},
+            device_kind=meta.get("device_kind"),
+            jax_version=meta.get("jax_version"),
+            strategy=meta.get("strategy"),
+            mesh=meta.get("mesh"),
+        ),
+        "phases": {
+            name: {
+                "count": h.count,
+                "p50_s": h.percentile(50),
+                "p95_s": h.percentile(95),
+                "max_s": h.max,
+                "total_s": h.sum,
+            }
+            for name, h in sorted(phases.items())
+        },
+        "counters": counters,
+    }

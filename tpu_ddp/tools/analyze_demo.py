@@ -101,6 +101,11 @@ def main(argv=None) -> int:
                 f"{len(inventory)} inventory entries, measured step p50 "
                 f"{measured['step_p50_s'] * 1e3:.1f} ms", flush=True,
             )
+        # $TPU_DDP_REGISTRY set (the CI registry workspace): archive
+        # this gate's artifact so CI runs accumulate a perf registry
+        from tpu_ddp.registry.store import record_if_env
+
+        record_if_env(artifact, note="analyze-demo")
 
     # -- 3. every strategy's collective fingerprint -----------------------
     failures = []

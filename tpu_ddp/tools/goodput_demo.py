@@ -277,6 +277,11 @@ def check_compare_gate(run_dir: str, scratch: str) -> bool:
         json.dump(baseline, f)
     with open(new_path, "w") as f:
         json.dump(incident, f)
+    # $TPU_DDP_REGISTRY set (the CI registry workspace): archive this
+    # gate's incident ledger so CI runs accumulate a perf registry
+    from tpu_ddp.registry.store import record_if_env
+
+    record_if_env(new_path, note="goodput-demo incident ledger")
     ok = True
     with contextlib.redirect_stdout(io.StringIO()):
         rc_same = cli_main(["bench", "compare", new_path, new_path])

@@ -54,6 +54,12 @@ def main(argv=None) -> int:
         print(f"[lint-demo] FAIL: tpu-ddp lint exited {rc} on the clean "
               "tree", file=sys.stderr)
         ok = False
+    else:
+        # $TPU_DDP_REGISTRY set (the CI registry workspace): archive
+        # this gate's artifact so CI runs accumulate a perf registry
+        from tpu_ddp.registry.store import record_if_env
+
+        record_if_env(artifact, note="lint-demo")
 
     # -- 2. injected violations must trip their rules ---------------------
     # (a) stripped donation: the same dp program compiled without

@@ -510,20 +510,19 @@ class Trainer:
         from tpu_ddp.telemetry import (
             RUN_META_SCHEMA_VERSION,
             build_telemetry,
+            config_digest,
+            git_provenance,
             next_incarnation,
         )
 
         # run_id: a short stable config digest — deterministic, so every
         # host of a multihost run derives the SAME id without a
         # coordination round, and the monitor exporter's /metrics labels
-        # line up across the fleet scrape
-        import hashlib
-
+        # line up across the fleet scrape. The recipe lives in
+        # telemetry.provenance so the perf registry's baseline matching
+        # shares the identity space.
         config_snapshot = dataclasses.asdict(config)
-        run_id = hashlib.sha1(
-            json.dumps(config_snapshot, sort_keys=True,
-                       default=str).encode()
-        ).hexdigest()[:10]
+        run_id = config_digest(config_snapshot)
         # incarnation: which life of this logical run this process is —
         # derived from the trace files already in the run dir, so a
         # --resume after a preemption/SIGKILL gets a fresh monotonic
@@ -545,6 +544,11 @@ class Trainer:
                              (int(s) for s in self.mesh.devices.shape))),
             "n_devices": self.world_size,
             "process_count": self.process_count,
+            # commit identity at the SOURCE: every downstream artifact
+            # (trace header, analyze/goodput/watch JSON, registry
+            # entries) inherits it instead of re-deriving; null outside
+            # a git checkout or without a git binary
+            **git_provenance(),
         }
         self.telemetry = build_telemetry(
             config.telemetry_dir,
