@@ -6,7 +6,7 @@ PYTHON ?= python
 .PHONY: test test-all dryrun bench smoke capture aot real-data lint \
 	trace-demo health-demo zero-demo compress-demo analyze-demo \
 	lint-demo monitor-demo profile-demo goodput-demo registry-demo \
-	bench-compare
+	tune-demo bench-compare
 
 # Fast default loop (round-3 verdict item 5): skips the `slow`-marked
 # multi-process / end-to-end-CLI / AOT tests. CI and pre-commit should run
@@ -192,6 +192,23 @@ registry-demo:
 	rm -rf $(REGISTRY_DEMO_DIR)
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
 	  $(PYTHON) -m tpu_ddp.tools.registry_demo --dir $(REGISTRY_DEMO_DIR)
+
+# Auto-tuner acceptance (docs/tuning.md): `tpu-ddp tune --chip v5e` on
+# the 4-virtual-device CPU mesh must rank a non-trivial grid (>= 30
+# candidates across the dp overlays + fsdp/tp/fsdp_tp meshes), every
+# ranked candidate lint-clean and under the v5e HBM cap; an injected
+# over-HBM candidate (per-shard 65536) must be excluded BY NAME with
+# the over_hbm status; a re-run of the same grid must compile 0 new
+# programs (the shared compile cache); the --json artifact must archive
+# through `registry record` as a tune-kind entry and a doctored
+# slower-winner copy must fail `bench compare`; and the emitted winner
+# TrainConfig must validate with its CLI line. Exits nonzero on any
+# miss (tpu_ddp/tools/tune_demo.py).
+TUNE_DEMO_DIR ?= /tmp/tpu_ddp_tune_demo
+tune-demo:
+	rm -rf $(TUNE_DEMO_DIR)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+	  $(PYTHON) -m tpu_ddp.tools.tune_demo --dir $(TUNE_DEMO_DIR)
 
 # Deviceless perf-regression gate: re-capture the AOT artifact with the
 # real XLA:TPU toolchain (needs libtpu; ~30+ min of compiles) and diff

@@ -39,6 +39,11 @@ Configs measured on a real chip (VERDICT round-1 item 3):
 MFU = XLA cost-model FLOPs of the compiled step (fusion/scan-aware) /
 wall-clock / bf16 peak of the device kind (``tpu_ddp/metrics/mfu.py``).
 
+``bench.py --config <winner.json>`` measures a tuner-emitted winner
+config verbatim (``tpu-ddp tune --emit-config``; docs/tuning.md)
+instead of the standard suite — same parent/child grant-safe
+choreography, one measured leg through the tuner's own trial runner.
+
 Timing methodology (all configs): end only after a value depending on
 every step has been fetched to the host — on remote-tunneled TPU runtimes
 ``block_until_ready`` alone can return before the donated-buffer chain has
@@ -881,6 +886,130 @@ def _bench_longseq_flash() -> dict:
     return _longseq_point("flash")
 
 
+def _read_winner_config(path: str) -> dict:
+    """The TrainConfig field dict out of a tuner artifact: either the
+    ``--emit-config`` winner shape ({"tune_winner_schema_version",
+    "config"}) or the full ``tune --json`` table ({"winner_config"})."""
+    with open(path) as f:
+        art = json.load(f)
+    version = art.get("tune_winner_schema_version")
+    if isinstance(version, int) and version > 1:
+        raise ValueError(
+            f"{path}: tune_winner_schema_version {version} is newer "
+            "than this bench understands (1)"
+        )
+    cfg = art.get("config")
+    if not isinstance(cfg, dict):
+        cfg = art.get("winner_config")
+    if not isinstance(cfg, dict):
+        raise ValueError(
+            f"{path}: no 'config' / 'winner_config' dict — pass the "
+            "artifact `tpu-ddp tune --emit-config` (or --json) wrote"
+        )
+    return cfg
+
+
+def _bench_tune_winner(path: str) -> dict:
+    """Measure a tuner-emitted winner config verbatim: the SAME short
+    measured trial ``tpu-ddp tune --validate-top`` runs
+    (``tuner/validate.py::measure_config`` — real Trainer, telemetry
+    join through the run-metadata header), a few more dispatches for a
+    steadier p50."""
+    import tempfile
+
+    from tpu_ddp.tuner.validate import measure_config
+
+    cfg = _read_winner_config(path)
+    run_dir = os.path.join(
+        tempfile.mkdtemp(prefix="bench_tune_winner_"), "run")
+    measured = measure_config(cfg, run_dir, trial_calls=6)
+    return {"config": cfg, **measured}
+
+
+def config_child_main(path: str) -> None:
+    """``bench.py --child --config winner.json``: one measured leg of
+    the tuner's winner, emitted in the bench headline shape."""
+    import traceback
+
+    import jax
+
+    try:
+        from tpu_ddp.telemetry.provenance import artifact_provenance
+
+        provenance = artifact_provenance(
+            descriptor={"artifact": "bench.py --config",
+                        "config_path": os.path.basename(path)},
+            device_kind=jax.devices()[0].device_kind,
+            jax_version=jax.__version__,
+        )
+    except Exception:
+        provenance = None
+    try:
+        row = _bench_tune_winner(path)
+        result = {
+            "metric": "tune_winner_images_per_sec_per_chip",
+            "value": row["measured_images_per_sec_per_chip"],
+            "unit": "images/sec/chip",
+            "backend": jax.default_backend(),
+            "device_kind": jax.devices()[0].device_kind,
+            "tune_winner": row,
+        }
+    except Exception:
+        result = {
+            "metric": "tune_winner_images_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "images/sec/chip",
+            "error": traceback.format_exc(limit=2).strip(),
+        }
+    if provenance:
+        result["provenance"] = provenance
+    _emit(result)
+    if "error" in result:
+        # a failed winner measurement must fail the invocation: a CI
+        # step gating on `bench.py --config` (or a registry ingesting
+        # the record) must never read a 0.0 rate as a clean pass
+        raise SystemExit(1)
+
+
+def _config_parent(path: str) -> None:
+    """Parent half of ``bench.py --config``: stdlib-only (never imports
+    jax), spawns the measuring child with the grant-safe choreography
+    and the usual probe-then-CPU-fallback ladder."""
+    ok, info = _probe_backend(dict(os.environ))
+    env = dict(os.environ) if ok else _scrubbed_cpu_env()
+    if not ok:
+        print(f"bench --config: backend probe failed ({info}); "
+              "measuring on the CPU backend", file=sys.stderr, flush=True)
+    cmd = [sys.executable, "-u", os.path.abspath(__file__),
+           "--child", "--config", path]
+    env["PYTHONUNBUFFERED"] = "1"
+    # a winner config pins its mesh; on the CPU backend the child needs
+    # that many virtual devices (the same bootstrap `tpu-ddp tune
+    # --devices` does)
+    try:
+        n_devices = int(_read_winner_config(path).get("n_devices") or 0)
+    except (OSError, ValueError, json.JSONDecodeError):
+        n_devices = 0
+    if n_devices and env.get("JAX_PLATFORMS", "cpu") in ("", "cpu") \
+            and "xla_force_host_platform_device_count" not in \
+            env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    out, err, wall = run_grant_safe_child(
+        cmd, max(60.0, _remaining() - 30), env=env)
+    sys.stdout.write(out)
+    sys.stdout.flush()
+    _record_attempt("config_bench", path=path, error=err, wall=round(wall, 1))
+    if err:
+        print(json.dumps({
+            "metric": "tune_winner_images_per_sec_per_chip",
+            "value": 0.0, "unit": "images/sec/chip", "error": err,
+        }), flush=True)
+        raise SystemExit(1)
+
+
 def _is_tpu_child() -> bool:
     # Child process only (tpu_ddp/jax are already imported here; the bench
     # PARENT must stay stdlib-only).
@@ -1195,9 +1324,24 @@ def _run_child(env, quick: bool, results_path: str, timeout_s: float):
     return last, err
 
 
+def _config_path_arg() -> str:
+    i = sys.argv.index("--config")
+    if i + 1 >= len(sys.argv):
+        raise SystemExit("bench.py --config needs a winner.json path")
+    return sys.argv[i + 1]
+
+
 def main() -> None:
     if "--child" in sys.argv:
+        if "--config" in sys.argv:
+            config_child_main(_config_path_arg())
+            return
         child_main(quick="--quick" in sys.argv)
+        return
+    if "--config" in sys.argv:
+        # measure a tuner-emitted winner config (tpu-ddp tune
+        # --emit-config) instead of the standard bench suite
+        _config_parent(_config_path_arg())
         return
 
     import signal

@@ -94,6 +94,10 @@ def test_memplan_reports_fit_for_v5e(v5e_topo):
     assert per["argument_bytes"] > 0 and per["est_peak_bytes"] > 0
     assert report["fits"] is True  # 76K-param model: trivially fits
     assert 0 < report["hbm_fraction"] < 0.05
+    # the report is the machine artifact --json writes, schema-versioned
+    from tpu_ddp.tools.memplan import MEMPLAN_SCHEMA_VERSION
+
+    assert report["memplan_schema_version"] == MEMPLAN_SCHEMA_VERSION
 
 
 def test_memplan_fsdp_scatters_state(v5e_topo):

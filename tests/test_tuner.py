@@ -1,0 +1,565 @@
+"""Auto-tuner: grid enumeration, deviceless pricing, ranking, artifacts.
+
+The load-bearing pins:
+
+- the FULL enumerated grid compiles devicelessly via
+  ``build_abstract_step`` on CPU — enumeration never emits an
+  uncompilable candidate (the conv grid with every overlay, the
+  vit grid's pp/sp, the moe grid's ep);
+- pricing arithmetic is hand-checked against the v5e chip spec
+  (roofline term, calibration ratio, dispatch amortization,
+  throughput);
+- the BENCH_r04 sweep grid (the 4 recorded netresdeep layout points)
+  ranks the measured-best configuration — (per-shard 256, K=128) —
+  first;
+- re-running a grid compiles 0 new programs (the shared compile
+  cache);
+- the over-HBM and lint gates exclude, never rank;
+- the tune artifact round-trips through ``load_artifact``, gates
+  through ``bench compare`` (quality drop = regression), archives as a
+  ``tune``-kind registry entry, and the emitted winner TrainConfig
+  validates;
+- ``--validate-top`` runs a real measured trial joined through the
+  run-metadata header.
+"""
+
+import json
+
+import jax
+import pytest
+
+from tpu_ddp.analysis.hlo import StepAnatomy, compile_cache_stats
+from tpu_ddp.tuner.calibrate import Calibration, calibration_for_chip
+from tpu_ddp.tuner.cli import (
+    build_tune_model,
+    tune_artifact,
+    winner_cli_line,
+    winner_config_fields,
+)
+from tpu_ddp.tuner.grid import Candidate, enumerate_grid, model_traits
+from tpu_ddp.tuner.price import price_anatomy, tune
+
+
+def _conv_model():
+    return build_tune_model("netresdeep", n_chans1=8, n_blocks=2,
+                            num_classes=10, image_size=32,
+                            compute_dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def conv_result(devices):
+    """The default conv grid on the 8-device mesh, tuned once for the
+    whole module (the heavyweight fixture every ranking/artifact test
+    reads)."""
+    model, label = _conv_model()
+    candidates = enumerate_grid(model, 8, batches=[8],
+                                steps_per_call=[1, 8])
+    result = tune(model=model, model_name=label, devices=devices,
+                  chip="v5e", candidates=candidates)
+    return result, candidates
+
+
+# -- grid enumeration ------------------------------------------------------
+
+
+def test_grid_covers_strategies_meshes_overlays(conv_result, devices):
+    result, candidates = conv_result
+    tokens = {c.strategy_token for c in candidates}
+    # conv family: the dp overlays + the three GSPMD layouts
+    assert {"dp", "zero1", "grad_compress", "zero1+grad_compress",
+            "fsdp", "tp", "fsdp_tp"} <= tokens
+    # tp sweeps every divisor mesh incl. the pure-model 8-way; fsdp_tp
+    # keeps a real data axis
+    tp_axes = {c.axis_size for c in candidates if c.parallelism == "tp"}
+    assert tp_axes == {2, 4, 8}
+    ftp_axes = {c.axis_size for c in candidates
+                if c.parallelism == "fsdp_tp"}
+    assert ftp_axes == {2, 4}
+
+
+def test_full_conv_grid_compiles_and_ranks(conv_result):
+    """The enumeration contract: every (strategy, mesh, overlay) point
+    compiles devicelessly — nothing excluded, everything lint-clean and
+    under the v5e cap."""
+    result, candidates = conv_result
+    assert len(result.ranked) == len(candidates)
+    assert result.excluded == []
+    for p in result.ranked:
+        assert p.status == "ok"
+        assert not any(r for r, n in p.lint_rule_counts.items() if n), \
+            f"{p.name}: lint counts {p.lint_rule_counts}"
+        assert p.hbm_fraction is not None and p.hbm_fraction < 1.0
+        assert p.predicted_images_per_sec_per_chip > 0
+    # ranked descending by predicted throughput
+    rates = [p.predicted_images_per_sec_per_chip for p in result.ranked]
+    assert rates == sorted(rates, reverse=True)
+
+
+def test_vit_and_moe_grid_points_compile(devices):
+    """pp/sp (ViT) and ep (MoE) enumeration points compile too — with
+    the conv fixture this covers every strategy family the grid can
+    emit."""
+    from tpu_ddp.models.moe import MoEViT
+    from tpu_ddp.models.vit import ViT
+
+    vit = ViT(patch_size=8, hidden_dim=32, depth=2, num_heads=2,
+              num_classes=10)
+    cands = enumerate_grid(vit, 8, batches=[8], steps_per_call=[1],
+                           strategies=["pp", "sp"])
+    assert {c.parallelism for c in cands} == {"pp", "sp"}
+    res = tune(model=vit, model_name="vit_tiny", devices=devices,
+               chip="v5e", candidates=cands)
+    assert res.excluded == [] and len(res.ranked) == len(cands)
+
+    moe = MoEViT(patch_size=8, hidden_dim=32, depth=2, num_heads=2,
+                 num_experts=4, top_k=1, moe_every=2, num_classes=10)
+    cands = enumerate_grid(moe, 8, batches=[8], steps_per_call=[1],
+                           strategies=["ep"])
+    assert {c.axis_size for c in cands} == {2, 4}
+    res = tune(model=moe, model_name="vit_moe_tiny", devices=devices,
+               chip="v5e", candidates=cands)
+    assert res.excluded == [] and len(res.ranked) == len(cands)
+
+
+def test_grid_constraints():
+    from tpu_ddp.models.vit import ViT
+
+    conv, _ = _conv_model()
+    vit = ViT(patch_size=8, hidden_dim=32, depth=2, num_heads=2,
+              num_classes=10)
+    # naming a family the model can't run raises; auto mode omits it
+    with pytest.raises(ValueError, match="does not apply"):
+        enumerate_grid(conv, 8, strategies=["pp"])
+    assert not any(c.parallelism == "pp" for c in enumerate_grid(conv, 8))
+    with pytest.raises(ValueError, match="unknown strategy"):
+        enumerate_grid(conv, 8, strategies=["warp"])
+    # overlays need a data axis >= 2
+    with pytest.raises(ValueError, match="data axis"):
+        enumerate_grid(conv, 1, strategies=["zero1"])
+    single = enumerate_grid(conv, 1)
+    assert all(not c.zero1 and not c.grad_compress for c in single)
+    # sp shards the token axis: 16 tokens on 8 devices -> axes {2, 4}
+    # (8 would leave data=1); pp stages divide depth 2 -> {2}
+    sp_axes = {c.axis_size
+               for c in enumerate_grid(vit, 8, strategies=["sp"])}
+    assert sp_axes == {2, 4}
+    pp_axes = {c.axis_size
+               for c in enumerate_grid(vit, 8, strategies=["pp"])}
+    assert pp_axes == {2}
+    # steps_per_call fuses the dp family only
+    ks = {(c.parallelism, c.steps_per_call)
+          for c in enumerate_grid(conv, 8, steps_per_call=[1, 8])}
+    assert ("dp", 8) in ks and ("fsdp", 8) not in ks
+
+
+def test_model_traits_and_support_matrix():
+    from tpu_ddp.train.strategy import supported_parallelisms
+
+    conv, _ = _conv_model()
+    assert model_traits(conv)["kind"] == "conv"
+    assert supported_parallelisms(conv) == ("dp", "fsdp", "tp", "fsdp_tp")
+    from tpu_ddp.models.vit import ViT
+
+    t = model_traits(ViT(patch_size=8, hidden_dim=32, depth=2,
+                         num_heads=2, num_classes=10))
+    assert t == {"kind": "vit", "depth": 2, "tokens": 16}
+    with pytest.raises(ValueError, match="no grid rules"):
+        model_traits(object())
+
+
+def test_candidate_name_and_program_key():
+    a = Candidate("dp", None, True, "int8", 32, 8)
+    assert a.name(8) == "dp+zero1+gc:int8/data=8/b32/k8"
+    assert a.strategy_token == "zero1+grad_compress"
+    assert a.lint_label(8) == "grad_compress"
+    assert a.lint_label(1) == "dp@single"
+    b = Candidate("dp", None, True, "int8", 32, 32)
+    assert a.program_key() == b.program_key()  # K shares the program
+    c = Candidate("tp", 4, False, None, 16, 1)
+    assert c.mesh_sizes(8) == {"data": 2, "model": 4}
+
+
+# -- shared compile cache --------------------------------------------------
+
+
+def test_rerun_hits_compile_cache(conv_result, devices):
+    """Acceptance: re-running the same grid compiles 0 new programs."""
+    result, candidates = conv_result
+    model, label = _conv_model()
+    before = compile_cache_stats()["misses"]
+    again = tune(model=model, model_name=label, devices=devices,
+                 chip="v5e", candidates=candidates)
+    assert compile_cache_stats()["misses"] == before
+    assert [p.name for p in again.ranked] == \
+        [p.name for p in result.ranked]
+
+
+def test_steps_per_call_shares_one_program(conv_result):
+    result, candidates = conv_result
+    assert result.compiled_programs == \
+        len({c.program_key() for c in candidates})
+    assert result.compiled_programs < len(candidates)
+
+
+# -- pricing arithmetic ----------------------------------------------------
+
+
+def _anatomy(**kw):
+    defaults = dict(
+        strategy="dp", model="m", device_kind="cpu", mesh={"data": 8},
+        n_devices=8, per_shard_batch=32, compute_dtype="float32",
+        flops=1e9, bytes_accessed=1e8, argument_bytes=10_000_000,
+        output_bytes=10_000_000, temp_bytes=5_000_000,
+        generated_code_bytes=None, fusion_count=0, hlo_ops={},
+        collectives=[],
+    )
+    defaults.update(kw)
+    return StepAnatomy(**defaults)
+
+
+def test_price_anatomy_hand_math():
+    """v5e: peak 197e12 flops, 8.1e11 HBM B/s. hbm term dominates:
+    predicted = 1e8/8.1e11; effective = that * ratio + overhead/K."""
+    cand = Candidate("dp", None, False, None, 32, 8)
+    p = price_anatomy(cand, _anatomy(), chip="v5e", n_devices=8,
+                      calibration_ratio=2.0,
+                      dispatch_overhead_s=400e-6)
+    assert p.status == "ok"
+    model_step = 1e8 / 8.1e11
+    assert p.model_step_s == pytest.approx(model_step)
+    assert p.bound == "hbm"
+    expected = model_step * 2.0 + 400e-6 / 8
+    assert p.effective_step_s == pytest.approx(expected)
+    # throughput: per_shard * data / n_devices / step = 32/step/1
+    assert p.predicted_images_per_sec_per_chip == pytest.approx(
+        32 / expected, rel=1e-3)
+    assert p.predicted_step_us == int(round(expected * 1e6))
+    assert p.peak_bytes == 15_000_000
+    assert p.hbm_fraction == pytest.approx(15e6 / 16e9, abs=1e-4)
+
+
+def test_dispatch_amortization_prefers_fused():
+    base = _anatomy()
+    rates = []
+    for k in (1, 8, 32):
+        p = price_anatomy(Candidate("dp", None, False, None, 32, k),
+                          base, chip="v5e", n_devices=8)
+        rates.append(p.predicted_images_per_sec_per_chip)
+    assert rates == sorted(rates)  # strictly better with more fusion
+    assert rates[0] < rates[-1]
+
+
+def test_over_hbm_is_excluded():
+    cand = Candidate("dp", None, False, None, 4096, 1)
+    p = price_anatomy(cand, _anatomy(temp_bytes=17_000_000_000),
+                      chip="v5e", n_devices=8)
+    assert p.status == "over_hbm"
+    assert "HBM capacity" in p.reason
+    assert p.predicted_images_per_sec_per_chip is None
+
+
+def test_lint_error_is_excluded():
+    cand = Candidate("dp", None, False, None, 32, 1)
+    p = price_anatomy(cand, _anatomy(), chip="v5e", n_devices=8,
+                      lint_rule_counts={"DON001": 1},
+                      lint_errors=["DON001: state not donated"])
+    assert p.status == "lint"
+    assert "DON001" in p.reason
+
+
+def test_unknown_chip_refused():
+    with pytest.raises(ValueError, match="no published peak"):
+        price_anatomy(Candidate("dp", None, False, None, 32, 1),
+                      _anatomy(), chip="cpu", n_devices=8)
+
+
+def test_cost_model_free_anatomy_unpriceable():
+    p = price_anatomy(Candidate("dp", None, False, None, 32, 1),
+                      _anatomy(flops=None, bytes_accessed=None),
+                      chip="v5e", n_devices=8)
+    assert p.status == "unpriceable"
+
+
+# -- the BENCH_r04 ordering pin -------------------------------------------
+
+
+def test_bench_r04_sweep_ranks_measured_best_first(devices):
+    """The 4 recorded netresdeep layout points (BENCH_r04 sweep leg:
+    84k->289k img/s across (K, per-shard) in {32,128} x {32,256}): the
+    tuner's predicted ranking must put the measured-best point —
+    per-shard 256, K=128 — first."""
+    from tpu_ddp.models import NetResDeep
+
+    model = NetResDeep()  # the full reference model the sweep measured
+    cands = enumerate_grid(model, 1, batches=[32, 256],
+                           steps_per_call=[32, 128], strategies=["dp"])
+    assert len(cands) == 4
+    res = tune(model=model, model_name="netresdeep",
+               devices=devices[:1], chip="v5e", candidates=cands)
+    # single-device programs have no collectives: the fingerprint tier
+    # must not reject them (lint_label -> dp@single)
+    assert res.excluded == []
+    best = res.winner.candidate
+    assert (best.per_shard_batch, best.steps_per_call) == (256, 128)
+
+
+# -- calibration -----------------------------------------------------------
+
+
+def test_calibration_from_analyze_artifact(tmp_path):
+    art = {
+        "anatomy": {"strategy": "dp", "device_kind": "TPU v5 lite"},
+        "measured": {"roofline_fraction": 0.5},
+    }
+    path = tmp_path / "analyze.json"
+    path.write_text(json.dumps(art))
+    cal = calibration_for_chip("v5e", sources=[str(path)])
+    assert cal.ratio == pytest.approx(2.0)
+    assert cal.samples == 1 and "analyze.json" in cal.source
+    # evidence from a different chip kind never calibrates this one
+    assert calibration_for_chip("v4", sources=[str(path)]).source == "none"
+
+
+def test_calibration_from_registry_tune_entries(tmp_path):
+    from tpu_ddp.registry.store import record_artifact
+
+    art = {
+        "tune_schema_version": 1,
+        "tune": {
+            "chip": "v5e", "winner": "w",
+            "predicted_images_per_sec_per_chip": 100.0,
+            "validated": [
+                {"name": "a", "device_kind": "TPU v5 lite",
+                 "measured_vs_model": 3.0},
+                {"name": "b", "device_kind": "cpu",
+                 "measured_vs_model": 9.0},  # wrong chip: ignored
+            ],
+        },
+    }
+    path = tmp_path / "tune.json"
+    path.write_text(json.dumps(art))
+    record_artifact(str(tmp_path / "reg"), str(path))
+    cal = calibration_for_chip("v5e", registry_dir=str(tmp_path / "reg"))
+    assert cal.ratio == pytest.approx(3.0)
+    assert cal.samples == 1 and cal.source.startswith("registry:")
+
+
+def test_calibration_defaults_to_identity(tmp_path):
+    cal = calibration_for_chip("v5e", sources=[str(tmp_path)])
+    assert cal == Calibration(1.0, "none", 0)
+
+
+def test_calibration_scales_but_never_reorders():
+    a = _anatomy(bytes_accessed=1e8)
+    b = _anatomy(bytes_accessed=2e8)
+    for ratio in (1.0, 3.0):
+        pa = price_anatomy(Candidate("dp", None, False, None, 32, 1), a,
+                           chip="v5e", n_devices=8,
+                           calibration_ratio=ratio)
+        pb = price_anatomy(Candidate("dp", None, False, None, 32, 1), b,
+                           chip="v5e", n_devices=8,
+                           calibration_ratio=ratio)
+        assert pa.predicted_images_per_sec_per_chip > \
+            pb.predicted_images_per_sec_per_chip
+
+
+# -- artifact / compare / registry ----------------------------------------
+
+
+def _winner_fields(priced):
+    return winner_config_fields(priced, model_name="netresdeep",
+                                n_chans1=8, n_blocks=2, num_classes=10,
+                                compute_dtype="float32", n_devices=8)
+
+
+def test_tune_artifact_roundtrip_and_compare_gate(conv_result, tmp_path):
+    from tpu_ddp.analysis.regress import compare, load_artifact
+
+    result, _ = conv_result
+    art = tune_artifact(result)
+    assert art["tune_schema_version"] == 1
+    rec = art["tune"]
+    assert rec["winner"] == result.winner.name
+    assert rec["n_ranked"] == len(result.ranked)
+    assert rec["predicted_step_us"] == result.winner.predicted_step_us
+    assert art["provenance"]["device_kind"] == "v5e"
+    path = tmp_path / "tune.json"
+    path.write_text(json.dumps(art))
+    loaded = load_artifact(str(path))
+    assert set(loaded) == {"tune"}
+    # self-compare: clean
+    assert compare(loaded, loaded)["regressions"] == []
+    # slower winner -> quality regression; fatter step -> size regression
+    slower = json.loads(json.dumps(loaded))
+    slower["tune"]["predicted_images_per_sec_per_chip"] *= 0.5
+    regs = compare(loaded, slower)["regressions"]
+    assert any("predicted_images_per_sec_per_chip" in r for r in regs)
+    fatter = json.loads(json.dumps(loaded))
+    fatter["tune"]["predicted_step_us"] = \
+        loaded["tune"]["predicted_step_us"] * 3 + 10_000
+    regs = compare(loaded, fatter)["regressions"]
+    assert any("predicted_step_us" in r for r in regs)
+
+
+def test_grid_descriptor_splits_series(conv_result):
+    """Differently-scoped sweeps must never collapse into one registry
+    series: the artifact digest folds the searched-space identity."""
+    from tpu_ddp.telemetry.provenance import config_digest
+
+    result, candidates = conv_result
+    desc = result.grid_descriptor()
+    assert desc["batches"] == [8]
+    assert desc["steps_per_call"] == [1, 8]
+    assert "zero1+grad_compress" in desc["strategies"]
+    art = tune_artifact(result)
+    assert art["tune"]["grid"] == desc
+    # a narrower grid over the same model/chip digests differently
+    import dataclasses as _dc
+
+    narrow = _dc.replace(result, ranked=result.ranked[:1], excluded=[])
+    assert narrow.grid_descriptor() != desc
+    assert config_digest({"grid": narrow.grid_descriptor()}) != \
+        config_digest({"grid": desc})
+
+
+def test_cli_refuses_winner_at_nonstandard_image_size(tmp_path):
+    """--image-size prices a program the Trainer cannot run: emitting
+    a winner or measuring trials at that size would describe a
+    different program than was priced."""
+    from tpu_ddp.tuner.cli import main as tune_main
+
+    rc = tune_main(["--chip", "v5e", "--devices", "4",
+                    "--image-size", "64", "--strategies", "dp",
+                    "--batches", "8", "--steps-per-call", "1",
+                    "--emit-config", str(tmp_path / "w.json")])
+    assert rc == 2
+    assert not (tmp_path / "w.json").exists()
+
+
+def test_registry_records_tune_artifact(conv_result, tmp_path):
+    from tpu_ddp.registry.store import read_entries, record_artifact
+
+    result, _ = conv_result
+    art = tune_artifact(result)
+    path = tmp_path / "tune.json"
+    path.write_text(json.dumps(art))
+    entry = record_artifact(str(tmp_path / "reg"), str(path))
+    assert entry.artifact_kind == "tune"
+    assert entry.device_kind == "v5e"
+    assert entry.config_digest == art["provenance"]["config_digest"]
+    assert entry.metrics[
+        "tune/quality/predicted_images_per_sec_per_chip"] == \
+        result.winner.predicted_images_per_sec_per_chip
+    assert entry.metrics["tune/size/predicted_step_us"] == \
+        result.winner.predicted_step_us
+    assert read_entries(str(tmp_path / "reg"))[-1].entry_id == \
+        entry.entry_id
+
+
+def test_winner_config_validates_and_cli_line(conv_result):
+    from tpu_ddp.tuner.validate import train_config_for
+
+    result, _ = conv_result
+    fields = _winner_fields(result.winner)
+    cfg = train_config_for(fields).validate()
+    assert cfg.model == "netresdeep" and cfg.n_chans1 == 8
+    assert cfg.mesh == {"data": 8}
+    line = winner_cli_line(fields)
+    assert line.startswith("tpu-ddp train ")
+    assert "--mesh data=8" in line
+    assert f"--batch-size {result.winner.candidate.per_shard_batch}" in line
+    if result.winner.candidate.zero1:
+        assert "--zero1" in line
+
+
+def test_winner_rejects_unknown_fields():
+    from tpu_ddp.tuner.validate import train_config_for
+
+    with pytest.raises(ValueError, match="unknown TrainConfig fields"):
+        train_config_for({"model": "netresdeep", "warp_factor": 9})
+
+
+# -- measured validation ---------------------------------------------------
+
+
+def test_validate_top_runs_measured_trial(devices, tmp_path):
+    from tpu_ddp.tuner.validate import validate_top
+
+    model, label = _conv_model()
+    cands = enumerate_grid(model, 4, batches=[8], steps_per_call=[1],
+                           strategies=["dp"])
+    result = tune(model=model, model_name=label, devices=devices[:4],
+                  chip="v5e", candidates=cands)
+    assert len(result.ranked) == 1
+
+    def fields(priced):
+        return winner_config_fields(
+            priced, model_name="netresdeep", n_chans1=8, n_blocks=2,
+            num_classes=10, compute_dtype="float32", n_devices=4)
+
+    validate_top(result, fields, top=1, workdir=str(tmp_path))
+    measured = result.ranked[0].measured
+    assert measured is not None and "error" not in measured, measured
+    assert measured["measured_step_s"] > 0
+    assert measured["measured_images_per_sec_per_chip"] > 0
+    assert measured["measured_vs_model"] == pytest.approx(
+        measured["measured_step_s"] / result.ranked[0].model_step_s,
+        rel=1e-3)
+    assert measured["device_kind"] == jax.devices()[0].device_kind
+    # the artifact carries the validated rows (calibration food)
+    art = tune_artifact(result)
+    assert art["tune"]["validated"][0]["measured_vs_model"] == \
+        measured["measured_vs_model"]
+
+
+# -- satellites: bench --config, memplan --json ---------------------------
+
+
+def test_bench_reads_winner_artifact(tmp_path):
+    import bench
+
+    winner = {"tune_winner_schema_version": 1,
+              "config": {"model": "netresdeep", "per_shard_batch": 8}}
+    path = tmp_path / "winner.json"
+    path.write_text(json.dumps(winner))
+    assert bench._read_winner_config(str(path)) == winner["config"]
+    # the full tune --json shape works too
+    full = {"tune_schema_version": 1,
+            "winner_config": {"model": "netresdeep"}}
+    path2 = tmp_path / "tune.json"
+    path2.write_text(json.dumps(full))
+    assert bench._read_winner_config(str(path2)) == {"model": "netresdeep"}
+    # future winner schema refused
+    path3 = tmp_path / "future.json"
+    path3.write_text(json.dumps({"tune_winner_schema_version": 99,
+                                 "config": {}}))
+    with pytest.raises(ValueError, match="newer"):
+        bench._read_winner_config(str(path3))
+    path4 = tmp_path / "empty.json"
+    path4.write_text("{}")
+    with pytest.raises(ValueError, match="config"):
+        bench._read_winner_config(str(path4))
+
+
+def test_bench_config_child_fails_loudly_on_error(tmp_path, capsys):
+    """A failed winner measurement must exit nonzero — a CI step
+    gating on `bench.py --config` can never read 0.0 as a pass."""
+    import bench
+
+    with pytest.raises(SystemExit) as exc:
+        bench.config_child_main(str(tmp_path / "missing.json"))
+    assert exc.value.code == 1
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["value"] == 0.0 and "error" in record
+
+
+def test_memplan_json_flag(tmp_path, monkeypatch):
+    from tpu_ddp.tools import memplan
+
+    stub = {"memplan_schema_version": memplan.MEMPLAN_SCHEMA_VERSION,
+            "model": "netresdeep", "fits": True, "hbm_fraction": 0.01,
+            "device_kind": "TPU v5 lite"}
+    monkeypatch.setattr(memplan, "plan", lambda *a, **kw: dict(stub))
+    out = tmp_path / "plan.json"
+    memplan.main(["--model", "netresdeep", "--json", str(out)])
+    assert json.loads(out.read_text()) == stub

@@ -17,15 +17,17 @@ fusion choices and temp bytes is real). Wall-clock fields
 (``compile_wall_s``) are reported, never gated — they measure the build
 machine, not the program.
 
-Understands five artifact shapes: ``benchmarks/aot_v5e.json``-style
+Understands six artifact shapes: ``benchmarks/aot_v5e.json``-style
 (``{"programs": {name: record}}``), ``tpu-ddp analyze --json`` output
 (``{"anatomy": ...}``), ``tpu-ddp goodput --json`` ledgers
 (``{"ledger": ...}`` — badput category presence gates exactly, the
 goodput fraction with tolerance, wall clock is reported only),
-``tpu-ddp trace summarize --json`` run summaries (measured phase
-percentiles: report-only here, trend-gated by the registry), and a
-bare single program record. Stdlib-only — no jax import — so it gates
-anywhere the JSON lands.
+``tpu-ddp tune --json`` ranked tables (``{"tune": ...}`` — the
+winner's predicted throughput gates as a higher-is-better quality
+metric, its predicted step time as a size), ``tpu-ddp trace summarize
+--json`` run summaries (measured phase percentiles: report-only here,
+trend-gated by the registry), and a bare single program record.
+Stdlib-only — no jax import — so it gates anywhere the JSON lands.
 
 ``--against <registry-dir>`` replaces the hand-pointed baseline file
 with auto-selection from the perf registry (docs/registry.md): the
@@ -44,7 +46,7 @@ _SIZE_KEYS = (
     "argument_size_in_bytes", "output_size_in_bytes", "temp_size_in_bytes",
     "generated_code_size_in_bytes", "s8_payload_bytes", "f32_payload_bytes",
     "argument_bytes", "output_bytes", "temp_bytes", "peak_bytes",
-    "flops", "bytes_accessed",
+    "flops", "bytes_accessed", "predicted_step_us",
 )
 _SIZE_NOISE_FLOOR = 1024
 
@@ -65,10 +67,11 @@ _SOFT_COUNT_KEYS = ("fusion_count",)
 #: (or, for a goodput ledger, the incident), not the program
 _WALL_KEYS = ("compile_wall_s", "elapsed_s")
 
-#: HIGHER-is-better fractional metrics (the goodput ledger's headline):
-#: a relative drop beyond tolerance is a regression, a rise an
-#: improvement — mirroring the sized-metric gate with the sign flipped
-_QUALITY_KEYS = ("goodput_fraction",)
+#: HIGHER-is-better metrics (the goodput ledger's headline fraction,
+#: and the tuner's predicted winner throughput): a relative drop beyond
+#: tolerance is a regression, a rise an improvement — mirroring the
+#: sized-metric gate with the sign flipped
+_QUALITY_KEYS = ("goodput_fraction", "predicted_images_per_sec_per_chip")
 
 
 def load_artifact(path: str) -> Dict[str, dict]:
@@ -96,6 +99,12 @@ def normalize_artifact(art, path: str = "<artifact>") -> Dict[str, dict]:
         # fresh restart_gap category = the benched run started failing),
         # goodput_fraction gates with tolerance, wall clock is noted
         return {"goodput": art["ledger"]}
+    if isinstance(art.get("tune"), dict):
+        # `tpu-ddp tune --json`: the winner's predicted throughput is
+        # the higher-is-better quality metric (a drop = the searched
+        # space got slower: a layout/pricing regression), the winner's
+        # predicted step time gates as a size
+        return {"tune": art["tune"]}
     if art.get("type") == "trace_summary" and isinstance(
             art.get("phases"), dict):
         # `tpu-ddp trace summarize --json`: measured per-phase

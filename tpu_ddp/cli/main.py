@@ -48,6 +48,13 @@ Subcommands:
   artifact family, REG-rule drift detection over per-(metric × config
   × chip) series, and entry-vs-entry diffs with the exact ``bench
   compare`` gating semantics (docs/registry.md).
+- ``tpu-ddp tune`` — roofline-guided auto-tuner: enumerates parallelism
+  strategy × mesh shape × ``--zero1``/``--grad-compress`` overlays ×
+  batch × ``steps_per_call``, compiles every candidate devicelessly,
+  prices each on the chip roofline under the HBM cap, rejects lint
+  findings, ranks by predicted images/sec/chip, and emits the winner
+  as a ready-to-run TrainConfig + CLI line. ``--validate-top K`` runs
+  short measured trials and re-ranks (docs/tuning.md).
 
 ``trace summarize``, ``health``, ``watch``, ``profile`` (modulo its
 lazy per-op join), ``registry``, and ``bench compare`` are stdlib-only
@@ -135,6 +142,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_ddp.registry.cli import main as registry_main
 
         return registry_main(argv[1:])
+    # tune compiles the candidate grid, so it needs jax — but the
+    # import stays inside its own main so the read-back commands keep
+    # their stdlib-only property
+    if argv[:1] == ["tune"]:
+        from tpu_ddp.tuner.cli import main as tune_main
+
+        return tune_main(argv[1:])
     if argv[:2] == ["bench", "compare"]:
         from tpu_ddp.analysis.regress import main as compare_main
 
@@ -197,6 +211,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="static step anatomy + roofline + collective fingerprint, "
              "optionally joined with a run dir's telemetry "
              "(tpu-ddp analyze --help)",
+    )
+    sub.add_parser(
+        "tune",
+        help="roofline-guided auto-tuner: search strategy x mesh x "
+             "overlay x batch x steps_per_call devicelessly, emit the "
+             "fastest lint-clean config (tpu-ddp tune --help)",
     )
     sub.add_parser(
         "lint",

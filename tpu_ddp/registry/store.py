@@ -164,6 +164,8 @@ def extract_metrics(programs: Dict[str, dict]) -> Dict[str, float]:
 def _artifact_kind(art: dict) -> str:
     if art.get("type") == "trace_summary":
         return "trace_summary"
+    if "tune_schema_version" in art:
+        return "tune"
     if isinstance(art.get("ledger"), dict):
         return "goodput_ledger"
     if isinstance(art.get("snapshot"), dict) and "alerts" in art:

@@ -14,7 +14,11 @@ groping at, with the compiler's ground truth instead of post-hoc
 utilization prints.
 
 The ``--remat`` flag makes the memory/FLOPs trade measurable: run twice
-and diff ``temp_size``.
+and diff ``temp_size``. ``--json out.json`` writes the same report as a
+schema-versioned machine artifact (``memplan_schema_version``), so
+scripts — and the auto-tuner's capacity checks, which share this
+module's peak = args + temp convention — consume the capacity oracle
+without parsing stdout.
 """
 
 from __future__ import annotations
@@ -22,6 +26,10 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+#: bump on any breaking change to the plan() report dict shape (the
+#: machine consumers: `--json`, the docs tables, the tuner's tests)
+MEMPLAN_SCHEMA_VERSION = 1
 
 
 # HBM capacity now comes from the shared chip-spec table
@@ -246,6 +254,7 @@ def _plan_inner(model_name, per_shard_batch, *, compute_dtype, remat,
 
     report_parallelism = "dp+zero1" if zero1 else parallelism
     return {
+        "memplan_schema_version": MEMPLAN_SCHEMA_VERSION,
         "model": model_name,
         "parallelism": report_parallelism,
         "zero1": zero1_report,
@@ -325,6 +334,10 @@ def main(argv=None) -> dict:
                         "for vit_b16, else 32)")
     p.add_argument("--num-classes", type=int, default=None,
                    help="default: model-aware — 1000 for vit_b16, else 10")
+    p.add_argument("--json", default=None, metavar="OUT.json",
+                   help="also write the schema-versioned report here — "
+                        "the machine-readable capacity oracle scripts "
+                        "and the tuner consume without parsing stdout")
     args = p.parse_args(argv)
     report = plan(
         args.model, args.batch_size, compute_dtype=args.compute_dtype,
@@ -337,6 +350,10 @@ def main(argv=None) -> dict:
         grad_compress_block=args.grad_compress_block,
     )
     print(json.dumps(report, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"memplan: wrote {args.json}", file=sys.stderr)
     if report["fits"] is False:
         print(f"memplan: DOES NOT FIT ({report['hbm_fraction']:.1%} of "
               f"{report['device_kind']} HBM)", file=sys.stderr)

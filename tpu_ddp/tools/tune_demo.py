@@ -1,0 +1,200 @@
+"""``make tune-demo`` — end-to-end proof of the auto-tuner loop.
+
+The observe→act acceptance story, run live on the 4-virtual-device CPU
+mesh (exit nonzero on any miss, so CI runs this beside registry-demo as
+a living gate):
+
+1. **A non-trivial grid ranks devicelessly**: ``tpu-ddp tune --chip
+   v5e`` over the default netresdeep grid must rank >= 30 candidates
+   across the dp-family overlays (zero1 / grad-compress / composed)
+   and the fsdp/tp/fsdp_tp meshes, every ranked candidate lint-clean
+   (no error-severity rule counts) and under the v5e HBM cap.
+2. **The capacity gate fires by name**: an injected over-HBM candidate
+   (per-shard batch 65536 — compiled peak ~16.9 GB against v5e's
+   16 GB) must land in the excluded list, BY NAME, with the
+   ``over_hbm`` status; it must never be ranked.
+3. **The compile cache closes the loop**: re-running the same grid in
+   the same process must compile **0** new programs (every candidate
+   hits the shared ``analysis/hlo.py`` cache).
+4. **The artifact archives + gates**: ``tune --json`` writes the
+   schema-versioned ranked table, ``tpu-ddp registry record`` archives
+   it as a ``tune``-kind entry under the tuner's config digest, and a
+   doctored copy with a slower winner must FAIL ``bench compare``
+   (quality-metric drop) while the self-compare passes.
+5. **The winner is runnable as emitted**: the ``--emit-config``
+   TrainConfig artifact round-trips through ``TrainConfig.validate()``
+   and carries the equivalent ``tpu-ddp train`` CLI line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import sys
+
+
+def _fail(msg: str) -> None:
+    print(f"[tune-demo] FAIL: {msg}", file=sys.stderr)
+
+
+def _cli(argv) -> tuple:
+    from tpu_ddp.cli.main import main as cli_main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(argv)
+    return rc, buf.getvalue()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", default="/tmp/tpu_ddp_tune_demo")
+    args = ap.parse_args(argv)
+    os.makedirs(args.dir, exist_ok=True)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < 4:
+        _fail(f"demo needs 4 virtual devices, got {len(jax.devices())} "
+              "(run via `make tune-demo`)")
+        return 1
+    devices = jax.devices()[:4]
+
+    from tpu_ddp.analysis.hlo import compile_cache_stats
+    from tpu_ddp.tuner.cli import build_tune_model
+    from tpu_ddp.tuner.grid import Candidate, enumerate_grid
+    from tpu_ddp.tuner.price import tune
+
+    model, label = build_tune_model(
+        "netresdeep", n_chans1=8, n_blocks=2, num_classes=10,
+        image_size=32, compute_dtype="float32")
+    candidates = enumerate_grid(model, 4, batches=[8, 16],
+                                steps_per_call=[1, 8, 32])
+    # the injected over-HBM candidate: per-shard 65536 compiles to
+    # ~16.9 GB peak (args+temp) on this model — just over v5e's 16 GB
+    over = Candidate(parallelism="dp", axis_size=None, zero1=False,
+                     grad_compress=None, per_shard_batch=65536,
+                     steps_per_call=1)
+    over_name = over.name(4)
+    print(f"[tune-demo] grid: {len(candidates)} candidates + injected "
+          f"{over_name}", flush=True)
+
+    result = tune(model=model, model_name=label, devices=devices,
+                  chip="v5e", candidates=list(candidates) + [over])
+
+    # 1. a non-trivial, fully lint-clean, under-cap ranking
+    if len(result.ranked) < 30:
+        _fail(f"expected >= 30 ranked candidates, got {len(result.ranked)}")
+        return 1
+    for p in result.ranked:
+        if p.status != "ok":
+            _fail(f"ranked candidate {p.name} has status {p.status}")
+            return 1
+        if p.hbm_fraction is None or p.hbm_fraction >= 1.0:
+            _fail(f"ranked candidate {p.name} over the HBM cap "
+                  f"({p.hbm_fraction})")
+            return 1
+    winner = result.winner
+    print(f"[tune-demo] ranked {len(result.ranked)}; winner {winner.name} "
+          f"(predicted {winner.predicted_images_per_sec_per_chip:g} "
+          "img/s/chip)", flush=True)
+
+    # 2. the injected over-HBM candidate is excluded BY NAME
+    hit = [p for p in result.excluded if p.name == over_name]
+    if not hit or hit[0].status != "over_hbm":
+        _fail(f"injected candidate {over_name} was not excluded as "
+              f"over_hbm (excluded: "
+              f"{[(p.name, p.status) for p in result.excluded]})")
+        return 1
+    if any(p.name == over_name for p in result.ranked):
+        _fail(f"injected over-HBM candidate {over_name} was RANKED")
+        return 1
+    print(f"[tune-demo] {over_name} excluded: {hit[0].reason}", flush=True)
+
+    # 3. a second identical sweep compiles 0 new programs
+    before = compile_cache_stats()["misses"]
+    tune(model=model, model_name=label, devices=devices, chip="v5e",
+         candidates=list(candidates) + [over])
+    after = compile_cache_stats()["misses"]
+    if after != before:
+        _fail(f"re-run compiled {after - before} new programs "
+              "(expected 0: the shared compile cache must hit)")
+        return 1
+    print("[tune-demo] re-run hit the compile cache (0 new programs)",
+          flush=True)
+
+    # 4. artifact: write via the CLI (same grid, --json + --emit-config),
+    # archive through `registry record`, gate through `bench compare`
+    art_path = os.path.join(args.dir, "tune.json")
+    winner_path = os.path.join(args.dir, "winner.json")
+    rc, out = _cli([
+        "tune", "--chip", "v5e", "--devices", "4",
+        "--batches", "8,16", "--json", art_path,
+        "--emit-config", winner_path, "--top", "5",
+    ])
+    if rc != 0 or not os.path.isfile(art_path):
+        _fail(f"tune CLI rc={rc}\n{out[-2000:]}")
+        return 1
+    registry_dir = os.path.join(args.dir, "registry")
+    rc, out = _cli(["registry", "--registry", registry_dir,
+                    "record", art_path])
+    if rc != 0:
+        _fail(f"registry record rc={rc}: {out}")
+        return 1
+    from tpu_ddp.registry.store import read_entries
+
+    entries = read_entries(registry_dir)
+    if not entries or entries[-1].artifact_kind != "tune":
+        kind = entries[-1].artifact_kind if entries else None
+        _fail(f"registry entry kind {kind!r}, expected 'tune'")
+        return 1
+    if not entries[-1].metrics.get(
+            "tune/quality/predicted_images_per_sec_per_chip"):
+        _fail("registry entry carries no tune quality metric "
+              f"(metrics: {sorted(entries[-1].metrics)[:8]})")
+        return 1
+    print(f"[tune-demo] archived {entries[-1].label()}", flush=True)
+
+    rc, _ = _cli(["bench", "compare", art_path, art_path])
+    if rc != 0:
+        _fail(f"self-compare of the tune artifact rc={rc} (expected 0)")
+        return 1
+    with open(art_path) as f:
+        art = json.load(f)
+    art["tune"]["predicted_images_per_sec_per_chip"] *= 0.5  # slower winner
+    slower = os.path.join(args.dir, "tune_slower.json")
+    with open(slower, "w") as f:
+        json.dump(art, f)
+    rc, out = _cli(["bench", "compare", art_path, slower])
+    if rc != 1 or "predicted_images_per_sec_per_chip" not in out:
+        _fail(f"compare did not flag the slower winner (rc={rc}):\n{out}")
+        return 1
+    print("[tune-demo] compare gate flags a slower winner", flush=True)
+
+    # 5. the emitted winner is runnable as emitted
+    with open(winner_path) as f:
+        winner_art = json.load(f)
+    from tpu_ddp.tuner.validate import train_config_for
+
+    train_config_for(winner_art["config"]).validate()
+    if not winner_art.get("cli", "").startswith("tpu-ddp train"):
+        _fail(f"winner artifact carries no CLI line: {winner_art}")
+        return 1
+    print(f"[tune-demo] winner config validates; cli: {winner_art['cli']}",
+          flush=True)
+
+    # best-effort: accumulate into the CI registry workspace
+    from tpu_ddp.registry.store import record_if_env
+
+    record_if_env(art_path, note="tune-demo ranked table")
+
+    print("[tune-demo] OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,6 +66,28 @@ MODE_AXIS = {
 }
 
 
+def supported_parallelisms(model) -> tuple:
+    """The parallelism families :func:`build_strategy` can build for
+    ``model`` — the one support matrix (conv families have TP channel
+    rules but no pipeline/sequence story; the transformer families add
+    pp/sp; MoE is the ep family's only model). The auto-tuner's grid
+    enumeration (``tpu_ddp/tuner/grid.py``) keys on this, so a family
+    added here is searched automatically."""
+    from tpu_ddp.models.moe import MoEViT
+    from tpu_ddp.models.resnet import NetResDeep
+    from tpu_ddp.models.resnet_family import ResNet, WideResNet
+    from tpu_ddp.models.vit import ViT
+
+    if isinstance(model, MoEViT):
+        return ("dp", "ep")
+    if isinstance(model, ViT):
+        return ("dp", "fsdp", "tp", "fsdp_tp", "pp", "sp")
+    if isinstance(model, (NetResDeep, ResNet, WideResNet)):
+        return ("dp", "fsdp", "tp", "fsdp_tp")
+    # a custom model with no TP rule set still data-parallels
+    return ("dp", "fsdp")
+
+
 def parse_mesh_arg(text: str) -> dict:
     """'data=2,model=4' -> {'data': 2, 'model': 4}. Axes must come from the
     mesh's named-axis set; -1 ("rest of the devices") allowed on one axis."""

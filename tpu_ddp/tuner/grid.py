@@ -1,0 +1,248 @@
+"""Candidate enumeration: the layout space ``tpu-ddp tune`` searches.
+
+A :class:`Candidate` is one point of the grid — (parallelism, non-data
+mesh axis size, ``--zero1``/``--grad-compress`` overlay, per-shard
+batch, ``steps_per_call``). Enumeration is CONSTRAINED so that every
+emitted point compiles through ``build_abstract_step``: the same family
+guards the Trainer enforces (overlays are dp-only, pp/sp/ep need their
+model families) plus the divisibility facts a mesh must satisfy
+(pipeline stages divide model depth, the sequence axis divides the
+token count, the expert axis divides the expert count, every axis
+divides the device count). ``tests/test_tuner.py`` pins that the full
+enumerated grid compiles devicelessly on CPU — the grid never emits an
+uncompilable candidate.
+
+``steps_per_call`` variants share their base candidate's compiled
+program (scan fusion is semantically identical per step — pinned since
+PR 1 by ``test_scan_multi_step_matches_sequential``), so they multiply
+the CANDIDATE count, not the compile count; the pricing model charges
+them a host-dispatch overhead of ``1/K`` instead (``price.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: every strategy token the grid understands: the analyzer's nine
+#: (analysis/explain.py::STRATEGIES) plus the composed dp overlay and
+#: the bf16 ring variant
+STRATEGY_TOKENS = (
+    "dp", "zero1", "grad_compress", "grad_compress_bf16",
+    "zero1+grad_compress", "fsdp", "tp", "fsdp_tp", "pp", "sp", "ep",
+)
+
+#: the dp-family layout overlays (all compile as parallelism "dp")
+OVERLAY_STRATEGIES = ("zero1", "grad_compress", "grad_compress_bf16",
+                      "zero1+grad_compress")
+
+# which parallelism families the grid may emit for a model comes from
+# the ONE support matrix beside the builders:
+# train/strategy.py::supported_parallelisms (imported lazily — this
+# module stays jax-import-free at module level)
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One grid point. ``axis_size`` is the size of the strategy's
+    non-data mesh axis (``train/strategy.py::MODE_AXIS``); ``None`` for
+    the 1-D data-mesh families (dp/fsdp). ``grad_compress`` is the wire
+    mode (``"int8"``/``"bf16"``) or ``None``."""
+
+    parallelism: str
+    axis_size: Optional[int]
+    zero1: bool
+    grad_compress: Optional[str]
+    per_shard_batch: int
+    steps_per_call: int
+
+    def mesh_sizes(self, n_devices: int) -> Dict[str, int]:
+        """Nontrivial ``{axis: size}`` for ``n_devices`` chips."""
+        from tpu_ddp.train.strategy import MODE_AXIS
+
+        axis = MODE_AXIS.get(self.parallelism)
+        if axis is None or not self.axis_size:
+            return {"data": n_devices}
+        return {"data": n_devices // self.axis_size,
+                axis: self.axis_size}
+
+    @property
+    def strategy_token(self) -> str:
+        """The grid token this candidate enumerates under."""
+        if self.zero1 and self.grad_compress:
+            return "zero1+grad_compress"
+        if self.grad_compress == "bf16":
+            return "grad_compress_bf16"
+        if self.grad_compress:
+            return "grad_compress"
+        if self.zero1:
+            return "zero1"
+        return self.parallelism
+
+    def lint_label(self, n_devices: int) -> str:
+        """Strategy label the lint/fingerprint tier audits this
+        candidate's program under. Mirrors
+        ``analysis/explain.py::run_strategy_label``: the compressed
+        ring's fingerprint wins when composed with zero1. A mesh with
+        no nontrivial axis (single-chip tuning) gets a label with no
+        pinned fingerprint — a 1-device program legitimately has no
+        collectives to pin (every other rule still runs)."""
+        sizes = [s for s in self.mesh_sizes(n_devices).values() if s > 1]
+        if not sizes:
+            return f"{self.parallelism}@single"
+        if self.grad_compress == "bf16":
+            return "grad_compress_bf16"
+        if self.grad_compress:
+            return "grad_compress"
+        if self.zero1:
+            return "zero1"
+        return self.parallelism
+
+    def name(self, n_devices: int) -> str:
+        """Stable display/artifact key, e.g.
+        ``dp+zero1+gc:int8/data=8/b32/k8``."""
+        head = self.parallelism
+        if self.zero1:
+            head += "+zero1"
+        if self.grad_compress:
+            head += f"+gc:{self.grad_compress}"
+        mesh = ",".join(f"{a}={s}"
+                        for a, s in self.mesh_sizes(n_devices).items())
+        return (f"{head}/{mesh}/b{self.per_shard_batch}"
+                f"/k{self.steps_per_call}")
+
+    def program_key(self) -> Tuple:
+        """Identity of the COMPILED program this candidate prices
+        against: everything but ``steps_per_call`` (scan-fused variants
+        share the per-step program)."""
+        return (self.parallelism, self.axis_size, self.zero1,
+                self.grad_compress, self.per_shard_batch)
+
+
+def model_traits(model, image_size: int = 32) -> dict:
+    """The divisibility facts grid constraints key on: model family
+    kind, transformer depth, token count, expert count."""
+    from tpu_ddp.models.moe import MoEViT
+    from tpu_ddp.models.resnet import NetResDeep
+    from tpu_ddp.models.resnet_family import ResNet, WideResNet
+    from tpu_ddp.models.vit import ViT
+
+    if isinstance(model, MoEViT):
+        return {"kind": "moe", "depth": model.depth,
+                "num_experts": model.num_experts}
+    if isinstance(model, ViT):
+        tokens = (image_size // model.patch_size) ** 2
+        return {"kind": "vit", "depth": model.depth, "tokens": tokens}
+    if isinstance(model, (NetResDeep, ResNet, WideResNet)):
+        return {"kind": "conv"}
+    raise ValueError(
+        f"tune has no grid rules for {type(model).__name__}; supported "
+        "families: NetResDeep/ResNet/WideResNet (conv), ViT, MoEViT"
+    )
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(2, n + 1) if n % d == 0]
+
+
+def _axis_sizes(parallelism: str, n_devices: int, traits: dict) -> List[int]:
+    """Valid non-data axis sizes for one mode-axis family. Conservative
+    by construction: only shapes the families are exercised with
+    (tp may take the whole mesh; the scatter/ring/schedule families
+    keep a data axis >= 2)."""
+    out = []
+    for d in _divisors(n_devices):
+        data = n_devices // d
+        if parallelism == "tp":
+            pass  # pure model-parallel (data=1) is a valid tp layout
+        elif data < 2:
+            continue  # fsdp_tp scatter / pp schedule / sp ring / ep
+            # dispatch all want a real data axis
+        if parallelism == "pp" and traits.get("depth", 0) % d:
+            continue  # stages must divide transformer depth
+        if parallelism == "sp" and traits.get("tokens", 0) % d:
+            continue  # ring shards the token axis evenly
+        if parallelism == "ep" and traits.get("num_experts", 0) % d:
+            continue  # expert axis must divide the expert count
+        out.append(d)
+    return out
+
+
+def enumerate_grid(
+    model,
+    n_devices: int,
+    *,
+    batches: Sequence[int] = (8, 32),
+    steps_per_call: Sequence[int] = (1, 8, 32),
+    strategies: Optional[Sequence[str]] = None,
+    image_size: int = 32,
+) -> List[Candidate]:
+    """The candidate grid for ``model`` on ``n_devices`` chips.
+
+    ``strategies`` restricts the grid to the named tokens (default: every
+    token the model's family supports); unknown tokens raise, and a
+    token the model cannot run is silently absent only in the default
+    (auto) mode — naming it explicitly raises, so a sweep script can't
+    think it searched a space it didn't.
+    """
+    from tpu_ddp.train.strategy import supported_parallelisms
+
+    traits = model_traits(model, image_size)
+    supported = supported_parallelisms(model)
+    explicit = strategies is not None
+    if strategies is None:
+        strategies = list(supported) + (
+            list(OVERLAY_STRATEGIES)
+            if "dp" in supported and n_devices >= 2 else [])
+    candidates: List[Candidate] = []
+    for token in strategies:
+        if token not in STRATEGY_TOKENS:
+            raise ValueError(
+                f"unknown strategy token {token!r}; choose from "
+                f"{STRATEGY_TOKENS}"
+            )
+        overlay = token in OVERLAY_STRATEGIES
+        parallelism = "dp" if overlay else token
+        if parallelism not in supported:
+            if explicit:
+                raise ValueError(
+                    f"strategy {token!r} does not apply to a "
+                    f"{traits['kind']} model (supported: {supported})"
+                )
+            continue
+        if overlay and n_devices < 2:
+            if explicit:
+                raise ValueError(
+                    f"strategy {token!r} needs a data axis >= 2 "
+                    f"(got {n_devices} device(s))"
+                )
+            continue
+        zero1 = token in ("zero1", "zero1+grad_compress")
+        compress = {"grad_compress": "int8",
+                    "grad_compress_bf16": "bf16",
+                    "zero1+grad_compress": "int8"}.get(token)
+        from tpu_ddp.train.strategy import MODE_AXIS
+
+        if MODE_AXIS.get(parallelism) is None:
+            axes: List[Optional[int]] = [None]
+        else:
+            axes = list(_axis_sizes(parallelism, n_devices, traits))
+            if not axes:
+                if explicit:
+                    raise ValueError(
+                        f"strategy {token!r} has no valid axis size on "
+                        f"{n_devices} devices for this model"
+                    )
+                continue
+        # steps_per_call fuses the dp family only (the Trainer warns and
+        # ignores the flag elsewhere) — other families get k=1
+        ks = sorted(set(steps_per_call)) if parallelism == "dp" else [1]
+        for axis in axes:
+            for batch in sorted(set(batches)):
+                for k in ks:
+                    candidates.append(Candidate(
+                        parallelism=parallelism, axis_size=axis,
+                        zero1=zero1, grad_compress=compress,
+                        per_shard_batch=int(batch), steps_per_call=int(k),
+                    ))
+    return candidates
