@@ -1,0 +1,708 @@
+"""Memory truth loop: live sampler, measured-vs-planned reconciliation,
+MEM001, OOM forensics, and the compare/registry/tuner integrations
+(docs/memory.md).
+
+The expensive fixtures are two REAL runs on the virtual CPU mesh,
+shared module-wide:
+
+- ``clean_dir`` — a short telemetry-on run whose sampler must leave a
+  per-device memory record (live-array accounting on CPU) the
+  reconciliation joins against the rebuilt static plan.
+- ``oom_dir``   — the same run with an injected ``RESOURCE_EXHAUSTED``
+  at step 5: the Trainer must write the postmortem bundle, emit the
+  ``oom_abort`` instant, and re-raise; the goodput ledger must classify
+  the exit as ``oom``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from tpu_ddp.analysis.hlo import StepAnatomy
+from tpu_ddp.ledger import build_ledger, ledger_json, stitch_run
+from tpu_ddp.memtrack.postmortem import (
+    attach_plan,
+    is_resource_exhausted,
+    list_postmortems,
+    read_postmortem,
+    write_postmortem,
+)
+from tpu_ddp.memtrack.reconcile import (
+    CPU_DEGRADATION_NOTE,
+    measured_summary,
+    read_mem_records,
+    reconcile,
+)
+from tpu_ddp.memtrack.report import main as mem_main, mem_json
+from tpu_ddp.memtrack.sampler import (
+    MEM_SCHEMA_VERSION,
+    MemorySampler,
+    host_rss_bytes,
+    mem_file_name,
+    publish_memory_gauges,
+)
+from tpu_ddp.telemetry import (
+    parse_sink_name,
+    parse_trace_name,
+    reset_default_registry,
+)
+from tpu_ddp.telemetry.registry import Registry
+from tpu_ddp.train.trainer import TrainConfig, Trainer
+
+OOM_AT_BATCH = 5
+
+
+@pytest.fixture(autouse=True)
+def _isolate_registry():
+    """The counters registry is process-wide by design; the Trainer runs
+    here must not leak train/steps etc. into later tests' snapshots (the
+    telemetry suite asserts exact counts)."""
+    reset_default_registry()
+    yield
+    reset_default_registry()
+
+
+class _OOMAfter:
+    """Raise an allocation-failure-shaped error after N batches: the
+    injected OOM (the loader is the one seam where a test can interrupt
+    the step loop without patching jax internals)."""
+
+    def __init__(self, inner, n_batches):
+        self._inner, self._n = inner, n_batches
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __iter__(self):
+        for i, batch in enumerate(self._inner):
+            if i >= self._n:
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                    "allocate 12345678 bytes")
+            yield batch
+
+    def __len__(self):
+        return len(self._inner)
+
+
+def _config(run_dir, **overrides):
+    base = dict(
+        synthetic_data=True,
+        synthetic_size=256,
+        epochs=1,
+        per_shard_batch=8,
+        model="netresdeep",
+        n_chans1=8,
+        n_blocks=2,
+        n_devices=4,
+        prefetch_depth=0,
+        log_every_epochs=1,
+        telemetry_dir=run_dir,
+        telemetry_sinks="jsonl",
+        telemetry_snapshot_steps=3,
+    )
+    base.update(overrides)
+    return TrainConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def clean_dir(tmp_path_factory):
+    run_dir = str(tmp_path_factory.mktemp("mem_clean"))
+    Trainer(_config(run_dir)).run()
+    return run_dir
+
+
+@pytest.fixture(scope="module")
+def oom_dir(tmp_path_factory):
+    run_dir = str(tmp_path_factory.mktemp("mem_oom"))
+    t = Trainer(_config(run_dir))
+    t.train_loader = _OOMAfter(t.train_loader, OOM_AT_BATCH)
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        t.run()
+    return run_dir
+
+
+# -- naming grammar --------------------------------------------------------
+
+
+def test_mem_file_name_shares_the_sink_grammar():
+    assert mem_file_name(0) == "mem-p0.jsonl"
+    assert mem_file_name(3, 2) == "mem-p3.i2.jsonl"
+    assert parse_sink_name("mem-p3.i2.jsonl") == ("mem", 3, 2, "jsonl")
+    assert parse_sink_name("mem-p0.jsonl", prefix="mem") == (
+        "mem", 0, 0, "jsonl")
+    # family filter: a mem name is NOT a trace name and vice versa
+    assert parse_sink_name("mem-p0.jsonl", prefix="trace") is None
+    assert parse_trace_name("mem-p0.jsonl") is None
+    # the trace family still round-trips through the shared parser
+    assert parse_trace_name("trace-p1.i4.jsonl") == (1, 4, "jsonl")
+    assert parse_sink_name("notes.txt") is None
+
+
+# -- sampler ---------------------------------------------------------------
+
+
+def test_sampler_synthetic_stats_roundtrip(tmp_path):
+    """Injected memory_stats flow through the sink record AND the
+    gauges exactly (the deviceless stand-in for a real chip)."""
+
+    class _Dev:
+        def __init__(self, i):
+            self.id = i
+            self.device_kind = "fake-tpu"
+
+    stats = {
+        0: {"bytes_in_use": 100, "peak_bytes_in_use": 160,
+            "bytes_limit": 1000},
+        1: {"bytes_in_use": 300, "peak_bytes_in_use": 500,
+            "bytes_limit": 1000},
+    }
+    devs = [_Dev(0), _Dev(1)]
+    sampler = MemorySampler(
+        str(tmp_path), process_index=0, incarnation=0,
+        devices=devs, stats_fn=lambda d: stats[d.id],
+        run_meta={"run_id": "cafe01"},
+    )
+    rec = sampler.sample(step=7)
+    sampler.close()
+    assert rec["devices"][1]["peak_bytes_in_use"] == 500
+    assert rec["devices"][0]["source"] == "memory_stats"
+    with open(tmp_path / "mem-p0.jsonl") as f:
+        lines = [json.loads(line) for line in f]
+    header, sample = lines
+    assert header["mem_schema_version"] == MEM_SCHEMA_VERSION
+    assert header["run_meta"]["run_id"] == "cafe01"
+    assert sample["step"] == 7
+    assert [d["bytes_in_use"] for d in sample["devices"]] == [100, 300]
+
+    reg = Registry()
+    publish_memory_gauges(reg, rec["devices"], rss=12345)
+    snap = reg.snapshot()["gauges"]
+    assert snap["memory/d0/bytes_in_use"] == 100
+    assert snap["memory/d1/bytes_in_use"] == 300
+    assert snap["memory/bytes_in_use_max"] == 300
+    assert snap["memory/high_water_bytes"] == 500
+    assert snap["memory/bytes_limit_per_device"] == 1000
+    assert snap["memory/high_water_frac"] == pytest.approx(0.5)
+    # fragmentation = worst per-device (peak - in_use) = 500-300 vs 60
+    assert snap["memory/fragmentation_bytes"] == 200
+    assert snap["memory/host_rss_bytes"] == 12345
+    # legacy aliases (pre-memtrack /metrics scrape contract)
+    assert snap["memory/bytes_in_use_total"] == 400
+    assert snap["memory/peak_bytes_in_use_max"] == 500
+
+
+def test_sampler_duty_cycle_backoff(tmp_path):
+    """An expensive sample (slow stats read) must gate the next one:
+    sampling spends at most ~2% of wall-clock, so the step loop being
+    observed is never taxed by its observer."""
+    import time as _time
+
+    class _Dev:
+        id = 0
+        device_kind = "fake"
+
+    def slow_stats(_d):
+        _time.sleep(0.005)   # 5 ms -> ~250 ms gate
+        return {"bytes_in_use": 1}
+
+    sampler = MemorySampler(str(tmp_path), devices=[_Dev()],
+                            stats_fn=slow_stats)
+    sampler.on_step(1)
+    sampler.on_step(2)       # inside the gate: skipped
+    assert sampler.samples_taken == 1
+    sampler._next_wall = 0.0  # gate expired
+    sampler.on_step(3)
+    assert sampler.samples_taken == 2
+    sampler.close()
+
+
+def test_sampler_stride_crosses_fused_steps(tmp_path):
+    """Scan fusion advances the step counter K at a time; the stride
+    must sample on boundary CROSSINGS, not `step % every == 0` (which
+    would alias to lcm(K, every))."""
+
+    class _Dev:
+        id = 0
+        device_kind = "fake"
+
+    sampler = MemorySampler(str(tmp_path), devices=[_Dev()],
+                            stats_fn=lambda d: {"bytes_in_use": 1},
+                            every=3)
+    for step in (2, 4, 6, 8):   # K=2: 3 and 9 never appear
+        sampler._next_wall = 0.0
+        sampler.on_step(step)
+    # crossings: first call (2), 2->4 crosses 3, 4->6 crosses 6; 6->8
+    # crosses nothing
+    assert sampler.samples_taken == 3
+    sampler.close()
+
+
+def test_high_water_gauge_is_monotone():
+    """A backend that only reports current residency must never see its
+    high-water gauge move backwards."""
+    reg = Registry()
+    publish_memory_gauges(
+        reg, [{"d": 0, "bytes_in_use": 900}], rss=None)
+    publish_memory_gauges(
+        reg, [{"d": 0, "bytes_in_use": 200}], rss=None)
+    snap = reg.snapshot()["gauges"]
+    assert snap["memory/bytes_in_use_max"] == 200     # current: moves
+    assert snap["memory/high_water_bytes"] == 900     # peak: latches
+
+
+def test_record_memory_gauges_cpu_fallback():
+    """The satellite fix: on a stats-less backend the epoch-boundary
+    adapter must emit PER-DEVICE gauges (live-array accounting) and the
+    host-RSS gauge instead of silently skipping."""
+    import jax.numpy as jnp
+
+    from tpu_ddp.metrics.memory import record_memory_gauges
+
+    anchor = jnp.ones((64, 64))  # at least one live buffer to count
+    reg = Registry()
+    record_memory_gauges(reg)
+    snap = reg.snapshot()["gauges"]
+    assert snap.get("memory/d0/bytes_in_use", 0) > 0
+    assert snap.get("memory/host_rss_bytes", 0) > 0
+    assert snap.get("memory/high_water_bytes", 0) > 0
+    del anchor
+
+
+def test_host_rss_bytes_positive():
+    rss = host_rss_bytes()
+    assert rss is not None and rss > 1024 * 1024
+
+
+# -- the real run's record -------------------------------------------------
+
+
+def test_run_writes_per_device_memory_record(clean_dir):
+    headers, records = read_mem_records(clean_dir)
+    assert headers and records
+    run_id = headers[0]["run_meta"]["run_id"]
+    from tpu_ddp.analysis.explain import read_run_meta
+
+    assert read_run_meta(clean_dir)["run_id"] == run_id
+    assert len(records[0]["devices"]) == 4
+    assert all(isinstance(d["bytes_in_use"], int)
+               for d in records[0]["devices"])
+    summary = measured_summary(clean_dir)
+    host = summary["hosts"][0]
+    assert host["samples"] == len(records)
+    assert host["high_water_bytes"] > 0
+    assert host["source"] == "live_arrays"
+    assert len(host["per_device"]) == 4
+
+
+def test_mem_gauges_scrapeable_as_openmetrics(clean_dir):
+    """The acceptance wording: per-device memory gauges scrapeable via
+    /metrics. The gauges land in the trace counters snapshots; render
+    them through the exporter's OpenMetrics path."""
+    from tpu_ddp.monitor.exporter import render_openmetrics
+    from tpu_ddp.telemetry.summarize import read_records
+
+    gauges = {}
+    for rec in read_records(
+            [os.path.join(clean_dir, "trace-p0.jsonl")]):
+        if rec.get("type") == "counters":
+            gauges.update((rec.get("attrs") or {}).get("gauges") or {})
+    body = render_openmetrics({"gauges": gauges})
+    for i in range(4):
+        assert f"tpu_ddp_memory_d{i}_bytes_in_use" in body
+    assert "tpu_ddp_memory_host_rss_bytes" in body
+
+
+def test_mem_sample_steps_zero_disables(tmp_path):
+    run_dir = str(tmp_path / "off")
+    Trainer(_config(run_dir, mem_sample_steps=0)).run()
+    assert not [n for n in os.listdir(run_dir) if n.startswith("mem-p")]
+
+
+def test_mem_sample_steps_validate():
+    with pytest.raises(ValueError, match="mem_sample_steps"):
+        _config("/tmp/x", mem_sample_steps=-1).validate()
+
+
+# -- reconciliation --------------------------------------------------------
+
+
+def test_reconcile_joins_measured_against_plan(clean_dir):
+    rec = reconcile(clean_dir)
+    assert rec["strategy"] == "dp"
+    planned = rec["planned"]
+    assert planned["peak_bytes"] == (
+        planned["argument_bytes"] + planned["temp_bytes"])
+    assert planned["top_buffers"], "top-buffer table missing"
+    sizes = [b["bytes"] for b in planned["top_buffers"]]
+    assert sizes == sorted(sizes, reverse=True)
+    # live-array accounting sees resident buffers only: the ratio is a
+    # real join but must be flagged non-calibratable with the CPU note
+    assert 0 < rec["measured_over_planned"] < 1.5
+    assert rec["calibratable"] is False
+    assert CPU_DEGRADATION_NOTE in rec["notes"]
+
+
+def test_reconcile_refuses_strategy_mismatch(clean_dir):
+    with pytest.raises(ValueError, match="recorded strategy"):
+        reconcile(clean_dir, expect_strategy="fsdp")
+
+
+def test_reconcile_refuses_mixed_run_dirs(tmp_path, clean_dir):
+    """A mem record whose header names a different run than the trace
+    header is a join-contract violation, not a silent mislabel."""
+    import shutil
+
+    mixed = tmp_path / "mixed"
+    mixed.mkdir()
+    shutil.copy(os.path.join(clean_dir, "trace-p0.jsonl"),
+                mixed / "trace-p0.jsonl")
+    with open(mixed / "mem-p0.jsonl", "w") as f:
+        f.write(json.dumps({
+            "type": "header", "mem_schema_version": 1, "pid": 0,
+            "incarnation": 0,
+            "run_meta": {"run_id": "someotherrun"}}) + "\n")
+        f.write(json.dumps({
+            "type": "mem", "schema_version": 1, "step": 0,
+            "devices": [{"d": 0, "bytes_in_use": 10}]}) + "\n")
+    with pytest.raises(ValueError, match="mixed run dirs"):
+        reconcile(str(mixed))
+
+
+def test_mem_records_future_schema_refused(tmp_path):
+    with open(tmp_path / "mem-p0.jsonl", "w") as f:
+        f.write(json.dumps({"type": "header",
+                            "mem_schema_version": 99}) + "\n")
+    with pytest.raises(ValueError, match="newer"):
+        read_mem_records(str(tmp_path))
+
+
+# -- MEM001 ----------------------------------------------------------------
+
+
+def _fleet_dir(tmp_path, fracs):
+    """Synthetic fleet: one trace per host with memory gauges at the
+    given fraction of a 16 GB limit."""
+    import time
+
+    now = time.time()
+    limit = 16_000_000_000
+    for pid, frac in enumerate(fracs):
+        recs = [{"type": "header", "schema_version": 1,
+                 "epoch_unix": now - 60, "pid": pid,
+                 "run_meta": {"run_id": "fleet", "strategy": "dp",
+                              "mesh": {"data": len(fracs)}}}]
+        for i in range(10):
+            recs.append({"type": "span", "name": "compiled_step",
+                         "ts_s": float(i), "dur_s": 0.5, "step": i,
+                         "depth": 0})
+        recs.append({
+            "type": "counters", "name": "counters_snapshot",
+            "ts_s": 11.0, "step": 10,
+            "attrs": {"gauges": {
+                "memory/high_water_bytes": int(limit * frac),
+                "memory/bytes_limit_per_device": limit,
+                "memory/high_water_frac": frac,
+            }}})
+        with open(tmp_path / f"trace-p{pid}.jsonl", "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        with open(tmp_path / f"heartbeat-p{pid}.json", "w") as f:
+            json.dump({"wall_time": now, "step": 10}, f)
+    return str(tmp_path)
+
+
+def test_mem001_fires_once_on_near_limit_host(tmp_path):
+    from tpu_ddp.monitor.aggregate import FleetAggregator, MonitorConfig
+    from tpu_ddp.monitor.alerts import AlertEngine
+
+    run_dir = _fleet_dir(tmp_path, [0.5, 0.5, 0.95, 0.5])
+    agg = FleetAggregator(run_dir, MonitorConfig())
+    engine = AlertEngine(MonitorConfig(), run_dir=run_dir,
+                         actions=(), once=True)
+    edges = engine.evaluate(agg.poll())
+    fired = [(a.rule, a.host) for a in edges if a.state == "firing"]
+    assert fired == [("MEM001", 2)]
+    # edge-triggered: the persisting condition produces no second edge
+    assert engine.evaluate(agg.poll()) == []
+    snap = agg.poll()
+    assert snap.fleet["hbm_high_water_frac"] == pytest.approx(0.95)
+    assert snap.hosts[2].memory["bytes_limit"] == 16_000_000_000
+
+
+def test_mem001_quiet_on_clean_fleet(tmp_path):
+    from tpu_ddp.monitor.aggregate import FleetAggregator, MonitorConfig
+    from tpu_ddp.monitor.alerts import AlertEngine
+
+    run_dir = _fleet_dir(tmp_path, [0.5, 0.6, 0.5, 0.55])
+    engine = AlertEngine(MonitorConfig(), run_dir=run_dir,
+                         actions=(), once=True)
+    edges = engine.evaluate(
+        FleetAggregator(run_dir, MonitorConfig()).poll())
+    assert not [a for a in edges if a.rule == "MEM001"]
+
+
+def test_mem001_disabled_by_zero_threshold(tmp_path):
+    from tpu_ddp.monitor.aggregate import FleetAggregator, MonitorConfig
+    from tpu_ddp.monitor.alerts import AlertEngine
+
+    run_dir = _fleet_dir(tmp_path, [0.99])
+    cfg = MonitorConfig(mem_limit_frac=0.0)
+    edges = AlertEngine(cfg, run_dir=run_dir, actions=(),
+                        once=True).evaluate(
+        FleetAggregator(run_dir, cfg).poll())
+    assert not [a for a in edges if a.rule == "MEM001"]
+
+
+def test_watch_renders_hbm_fraction(tmp_path):
+    from tpu_ddp.monitor.aggregate import FleetAggregator, MonitorConfig
+    from tpu_ddp.monitor.alerts import AlertEngine
+    from tpu_ddp.monitor.watch import build_report, render_report
+
+    run_dir = _fleet_dir(tmp_path, [0.5, 0.95])
+    report = build_report(
+        FleetAggregator(run_dir, MonitorConfig()),
+        AlertEngine(MonitorConfig(), run_dir=run_dir, actions=(),
+                    once=True))
+    text = render_report(report)
+    assert "hbm 95%" in text
+    assert "MEM001" in text
+
+
+# -- OOM forensics ---------------------------------------------------------
+
+
+def test_is_resource_exhausted_classification():
+    positives = [
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying "
+                     "to allocate 68719476736 bytes"),
+        RuntimeError("Allocation of 1234 bytes failed"),
+        MemoryError("out of memory"),
+        RuntimeError("failed to allocate request for 2.5GiB"),
+    ]
+    negatives = [
+        ValueError("shape mismatch (4, 3) vs (4, 5)"),
+        RuntimeError("simulated hard kill"),
+        KeyError("missing"),
+    ]
+    assert all(is_resource_exhausted(e) for e in positives)
+    assert not any(is_resource_exhausted(e) for e in negatives)
+
+
+def test_oom_postmortem_bundle(oom_dir):
+    bundles = list_postmortems(oom_dir)
+    assert len(bundles) == 1
+    b = bundles[0]
+    assert b["step"] == OOM_AT_BATCH
+    assert b["process_index"] == 0
+    assert b["error_type"] == "RuntimeError"
+    assert "RESOURCE_EXHAUSTED" in b["error"]
+    # the evidence: samples ring (incl. one taken AT death), config
+    # snapshot, and the run meta the plan rebuild needs
+    assert b["samples"], "no memory samples in the bundle"
+    assert b["config"]["model"] == "netresdeep"
+    assert b["run_meta"]["strategy"] == "dp"
+    # one-shot: a rewrite attempt returns the existing bundle untouched
+    again = write_postmortem(oom_dir, step=OOM_AT_BATCH,
+                             process_index=0)
+    assert again == b["path"]
+    assert read_postmortem(b["path"])["n_samples"] == b["n_samples"]
+
+
+def test_oom_ledger_exit_and_failure_count(oom_dir):
+    ledger = build_ledger(stitch_run(oom_dir))
+    assert [e.exit for e in ledger.incarnations] == ["oom"]
+    assert ledger.n_failures == 1          # oom is a FAILURE_EXIT
+    art = ledger_json(ledger)["ledger"]
+    assert art["exit_counts"] == {"oom": 1}
+
+
+def test_attach_plan_writes_top_buffers(oom_dir):
+    bundle = list_postmortems(oom_dir)[0]["path"]
+    plan = attach_plan(bundle)
+    assert plan is not None
+    assert plan["peak_bytes"] == (
+        plan["argument_bytes"] + plan["temp_bytes"])
+    sizes = [b["bytes"] for b in plan["top_buffers"]]
+    assert sizes and sizes == sorted(sizes, reverse=True)
+    assert os.path.isfile(os.path.join(bundle, "plan.json"))
+    # idempotent: the second call reads the file back
+    assert attach_plan(bundle) == plan
+    # and the read-back bundle now carries the plan
+    assert list_postmortems(oom_dir)[0]["plan"]["peak_bytes"] == \
+        plan["peak_bytes"]
+
+
+def test_oom_instant_in_trace(oom_dir):
+    from tpu_ddp.telemetry.summarize import read_records
+
+    records = read_records([os.path.join(oom_dir, "trace-p0.jsonl")])
+    instants = [r for r in records if r.get("type") == "instant"
+                and r.get("name") == "oom_abort"]
+    assert len(instants) == 1
+    assert instants[0]["step"] == OOM_AT_BATCH
+
+
+# -- CLI -------------------------------------------------------------------
+
+
+def test_cli_exit_codes(clean_dir, oom_dir, tmp_path, capsys):
+    assert mem_main([clean_dir]) == 0
+    capsys.readouterr()
+    assert mem_main([oom_dir]) == 1          # an OOM run is scriptably bad
+    capsys.readouterr()
+    assert mem_main([str(tmp_path / "nope")]) == 2
+    capsys.readouterr()
+    assert mem_main([clean_dir, "--strategy", "fsdp"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_render_surfaces(clean_dir, capsys):
+    assert mem_main([clean_dir]) == 0
+    out = capsys.readouterr().out
+    assert "measured vs planned" in out
+    assert "planned peak (args+temp)" in out
+    assert "top planned buffers" in out
+    assert "host 0 |" in out                 # the timeline sparkline
+    assert CPU_DEGRADATION_NOTE in out
+
+
+def test_cli_no_plan_is_stdlib_only(clean_dir, capsys):
+    assert mem_main([clean_dir, "--no-plan"]) == 0
+    out = capsys.readouterr().out
+    assert "plan join skipped" in out
+
+
+# -- artifact: registry + compare gates ------------------------------------
+
+
+def test_mem_artifact_registry_recordable(clean_dir, tmp_path):
+    from tpu_ddp.registry.store import record_artifact
+
+    art = mem_json(clean_dir)
+    path = tmp_path / "mem.json"
+    path.write_text(json.dumps(art))
+    entry = record_artifact(str(tmp_path / "reg"), str(path))
+    assert entry.artifact_kind == "mem"
+    # identity: the run's own deterministic config digest, so the mem
+    # series trends beside the run's analyze/goodput entries
+    assert entry.config_digest == art["mem"]["run_id"]
+    assert entry.metrics["mem/count/oom_count"] == 0.0
+    assert entry.metrics["mem/size/measured_high_water_bytes"] > 0
+    assert entry.metrics["mem/size/peak_bytes"] > 0
+
+
+def test_compare_gates_mem_artifact(clean_dir, tmp_path, capsys):
+    from tpu_ddp.cli.main import main as cli_main
+
+    art = mem_json(clean_dir)
+    old = tmp_path / "old.json"
+    old.write_text(json.dumps(art))
+    assert cli_main(["bench", "compare", str(old), str(old)]) == 0
+    capsys.readouterr()
+    bad = json.loads(json.dumps(art))
+    bad["mem"]["oom_count"] = 1
+    bad["mem"]["measured_high_water_bytes"] = int(
+        art["mem"]["measured_high_water_bytes"] * 2)
+    new = tmp_path / "new.json"
+    new.write_text(json.dumps(bad))
+    assert cli_main(["bench", "compare", str(old), str(new)]) == 1
+    out = capsys.readouterr().out
+    assert "oom_count" in out
+    assert "measured_high_water_bytes" in out
+
+
+def test_compare_gates_fresh_oom_exit(tmp_path, capsys):
+    """The union-of-keys semantics: a fresh `oom` exit-count key in a
+    goodput ledger gates 0 -> N; extra CLEAN incarnations never do."""
+    from tpu_ddp.cli.main import main as cli_main
+
+    def ledger_art(exit_counts):
+        return {"schema_version": 1, "type": "goodput_ledger",
+                "ledger": {"goodput_fraction": 0.9,
+                           "category_presence": {"compile": 1},
+                           "exit_counts": exit_counts}}
+
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(ledger_art({"clean": 1})))
+    new.write_text(json.dumps(ledger_art({"clean": 2, "oom": 1})))
+    assert cli_main(["bench", "compare", str(old), str(new)]) == 1
+    assert "exits/oom" in capsys.readouterr().out
+    # reverse direction: the oom disappearing is an improvement
+    assert cli_main(["bench", "compare", str(new), str(old)]) == 0
+    capsys.readouterr()
+
+
+# -- tuner HBM-cap calibration ---------------------------------------------
+
+
+def _mem_artifact(ratio, device_kind="TPU v5 lite", calibratable=True):
+    return {"mem_schema_version": 1, "type": "memtrack",
+            "mem": {"run_id": "r1", "device_kind": device_kind,
+                    "measured_over_planned": ratio,
+                    "calibratable": calibratable,
+                    "measured_high_water_bytes": 1, "peak_bytes": 1,
+                    "oom_count": 0}}
+
+
+def test_hbm_calibration_from_artifacts_and_registry(tmp_path):
+    from tpu_ddp.registry.store import record_artifact
+    from tpu_ddp.tuner.calibrate import hbm_calibration_for_chip
+
+    a = tmp_path / "a.json"
+    a.write_text(json.dumps(_mem_artifact(1.3)))
+    cal = hbm_calibration_for_chip("v5e", sources=[str(a)])
+    assert cal.ratio == pytest.approx(1.3)
+    assert cal.samples == 1
+
+    # non-calibratable (live-array) and wrong-chip evidence is ignored
+    b = tmp_path / "b.json"
+    b.write_text(json.dumps(_mem_artifact(0.2, device_kind="cpu",
+                                          calibratable=False)))
+    c = tmp_path / "c.json"
+    c.write_text(json.dumps(_mem_artifact(9.9, device_kind="TPU v4")))
+    cal = hbm_calibration_for_chip(
+        "v5e", sources=[str(a), str(b), str(c)])
+    assert cal.ratio == pytest.approx(1.3)
+
+    # registry-archived mem entries feed the same median
+    reg = str(tmp_path / "reg")
+    record_artifact(reg, str(a))
+    cal = hbm_calibration_for_chip("v5e", registry_dir=reg)
+    assert cal.ratio == pytest.approx(1.3)
+    assert cal.source.startswith("registry:")
+
+    # no evidence -> identity
+    assert hbm_calibration_for_chip("v5e").ratio == 1.0
+
+
+def test_price_anatomy_applies_hbm_calibration():
+    """peak 15 MB on a 16 GB chip fits at ratio 1.0; a measured 1200x
+    ratio (synthetic) pushes the calibrated peak over the cap and the
+    exclusion names the calibration."""
+    from tpu_ddp.tuner.grid import Candidate
+    from tpu_ddp.tuner.price import price_anatomy
+
+    defaults = dict(
+        strategy="dp", model="m", device_kind="cpu", mesh={"data": 8},
+        n_devices=8, per_shard_batch=32, compute_dtype="float32",
+        flops=1e9, bytes_accessed=1e8, argument_bytes=10_000_000,
+        output_bytes=10_000_000, temp_bytes=5_000_000,
+        generated_code_bytes=None, fusion_count=0, hlo_ops={},
+        collectives=[],
+    )
+    anatomy = StepAnatomy(**defaults)
+    cand = Candidate("dp", None, False, None, 32, 8)
+    ok = price_anatomy(cand, anatomy, chip="v5e", n_devices=8)
+    assert ok.status == "ok"
+    over = price_anatomy(cand, anatomy, chip="v5e", n_devices=8,
+                         hbm_calibration_ratio=1200.0)
+    assert over.status == "over_hbm"
+    assert "measured HBM calibration" in over.reason
+    # the fraction scales linearly with the calibration ratio
+    assert over.hbm_fraction == pytest.approx(
+        15e6 * 1200.0 / 16e9, rel=1e-3)

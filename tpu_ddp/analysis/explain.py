@@ -355,17 +355,11 @@ def run_strategy_label(meta: dict) -> str:
     return strategy
 
 
-def anatomy_for_run_meta(meta: dict, devices) -> StepAnatomy:
-    """Rebuild the EXACT program a recorded run trained with, from its
-    run-metadata header: the real model (``build_model`` on the recorded
-    config snapshot — widths, depths, num_classes and all), the real
-    optimizer chain (kind / momentum / weight-decay mask / EMA / clip /
-    zero1 sharding), the real dp-family layout composition
-    (``--zero1 --grad-compress`` builds BOTH, exactly like the Trainer),
-    and the program-shaping extras (``--health on`` in-graph stats,
-    ``--pp-schedule``, ``--sp-flash``). Raises for programs the abstract
-    builder cannot reproduce (sp+zero1 composition, scan-fused
-    ``--steps-per-call``) — refusing beats mis-attributing."""
+def _run_meta_program(meta: dict, devices):
+    """The compile-ready rebuild behind :func:`anatomy_for_run_meta` and
+    :func:`compiled_for_run_meta`: ``(step, state, mesh, cache_key,
+    cfg)`` for the recorded program. Raises for programs the abstract
+    builder cannot reproduce."""
     import dataclasses as _dc
 
     import jax
@@ -459,12 +453,39 @@ def anatomy_for_run_meta(meta: dict, devices) -> StepAnatomy:
     key = ("analyze-run", json.dumps(config_rec, sort_keys=True),
            parallelism, tuple(sorted(mesh_shape.items())),
            devices[0].device_kind, len(list(devices)))
+    return step, state, mesh, key, cfg
+
+
+def anatomy_for_run_meta(meta: dict, devices) -> StepAnatomy:
+    """Rebuild the EXACT program a recorded run trained with, from its
+    run-metadata header: the real model (``build_model`` on the recorded
+    config snapshot — widths, depths, num_classes and all), the real
+    optimizer chain (kind / momentum / weight-decay mask / EMA / clip /
+    zero1 sharding), the real dp-family layout composition
+    (``--zero1 --grad-compress`` builds BOTH, exactly like the Trainer),
+    and the program-shaping extras (``--health on`` in-graph stats,
+    ``--pp-schedule``, ``--sp-flash``). Raises for programs the abstract
+    builder cannot reproduce (sp+zero1 composition, scan-fused
+    ``--steps-per-call``) — refusing beats mis-attributing."""
+    step, state, mesh, key, cfg = _run_meta_program(meta, devices)
     return _compile_anatomy(
         step, state, mesh, cache_key=key,
         strategy=run_strategy_label(meta), model_name=cfg.model,
         per_shard_batch=cfg.per_shard_batch, image_size=32,
         compute_dtype=cfg.compute_dtype,
     )
+
+
+def compiled_for_run_meta(meta: dict, devices):
+    """The cached COMPILED executable of a recorded run's rebuilt
+    program — what the memory truth loop's plan side reads buffer sizes
+    and the memory analysis from (``memtrack/postmortem.py``). Shares
+    :func:`anatomy_for_run_meta`'s cache key, so plan-after-anatomy (or
+    vice versa) compiles once."""
+    step, state, mesh, key, cfg = _run_meta_program(meta, devices)
+    batch = abstract_batch(mesh, cfg.per_shard_batch, 32)
+    return cached_compile(
+        key, lambda: step.trace(state, batch).lower().compile())
 
 
 # -- run-dir metadata + measured-phase join -------------------------------

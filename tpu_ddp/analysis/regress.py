@@ -17,16 +17,19 @@ fusion choices and temp bytes is real). Wall-clock fields
 (``compile_wall_s``) are reported, never gated — they measure the build
 machine, not the program.
 
-Understands six artifact shapes: ``benchmarks/aot_v5e.json``-style
+Understands seven artifact shapes: ``benchmarks/aot_v5e.json``-style
 (``{"programs": {name: record}}``), ``tpu-ddp analyze --json`` output
 (``{"anatomy": ...}``), ``tpu-ddp goodput --json`` ledgers
-(``{"ledger": ...}`` — badput category presence gates exactly, the
-goodput fraction with tolerance, wall clock is reported only),
-``tpu-ddp tune --json`` ranked tables (``{"tune": ...}`` — the
-winner's predicted throughput gates as a higher-is-better quality
-metric, its predicted step time as a size), ``tpu-ddp trace summarize
---json`` run summaries (measured phase percentiles: report-only here,
-trend-gated by the registry), and a bare single program record.
+(``{"ledger": ...}`` — badput category presence AND failure-exit
+counts gate exactly, the goodput fraction with tolerance, wall clock
+is reported only), ``tpu-ddp tune --json`` ranked tables
+(``{"tune": ...}`` — the winner's predicted throughput gates as a
+higher-is-better quality metric, its predicted step time as a size),
+``tpu-ddp mem --json`` memory reports (``{"mem": ...}`` — planned
+peak and measured high-water gate as sizes, a fresh ``oom_count``
+exactly), ``tpu-ddp trace summarize --json`` run summaries (measured
+phase percentiles: report-only here, trend-gated by the registry),
+and a bare single program record.
 Stdlib-only — no jax import — so it gates anywhere the JSON lands.
 
 ``--against <registry-dir>`` replaces the hand-pointed baseline file
@@ -47,11 +50,20 @@ _SIZE_KEYS = (
     "generated_code_size_in_bytes", "s8_payload_bytes", "f32_payload_bytes",
     "argument_bytes", "output_bytes", "temp_bytes", "peak_bytes",
     "flops", "bytes_accessed", "predicted_step_us",
+    "measured_high_water_bytes",
 )
 _SIZE_NOISE_FLOOR = 1024
 
 #: count metrics (exact): any increase is a regression
-_COUNT_KEYS = ("s8_collective_permute_count", "f32_collective_permute_count")
+_COUNT_KEYS = ("s8_collective_permute_count", "f32_collective_permute_count",
+               "oom_count")
+
+#: goodput-ledger exit classes that gate as exact counts with
+#: union-of-keys semantics: a FRESH failure key (e.g. `oom` appearing
+#: where the baseline had none) reads 0 -> N, a regression. Mirrors
+#: ledger/taxonomy.py::FAILURE_EXITS (duplicated so this module stays
+#: stdlib-only and import-light, like _COLLECTIVE_OPS).
+_FAILURE_EXIT_KEYS = ("killed", "hang", "preempted", "oom")
 
 #: opcodes whose counts are COLLECTIVES — exact-gated (an extra one is a
 #: layout change, never noise). Mirrors analysis/hlo.py::COLLECTIVE_OPS
@@ -105,6 +117,11 @@ def normalize_artifact(art, path: str = "<artifact>") -> Dict[str, dict]:
         # space got slower: a layout/pricing regression), the winner's
         # predicted step time gates as a size
         return {"tune": art["tune"]}
+    if isinstance(art.get("mem"), dict):
+        # `tpu-ddp mem --json`: planned peak + measured high-water gate
+        # as sizes, a fresh oom_count gates exactly; the measured-over-
+        # planned ratio is calibration food, not a gate
+        return {"mem": art["mem"]}
     if art.get("type") == "trace_summary" and isinstance(
             art.get("phases"), dict):
         # `tpu-ddp trace summarize --json`: measured per-phase
@@ -153,6 +170,11 @@ def _counts(rec: dict) -> Dict[str, int]:
             out[f"lint/{rule}"] = int(n)
     for cat, present in (rec.get("category_presence") or {}).items():
         out[f"badput/{cat}"] = int(bool(present))
+    for cls, n in (rec.get("exit_counts") or {}).items():
+        # failure exits only: two clean incarnations vs one is not a
+        # regression, a fresh oom/hang/kill always is
+        if cls in _FAILURE_EXIT_KEYS and isinstance(n, (int, float)):
+            out[f"exits/{cls}"] = int(n)
     return out
 
 

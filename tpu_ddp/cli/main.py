@@ -26,6 +26,12 @@ Subcommands:
   taxonomy (restart gaps, replayed steps, stalls, checkpoint/compile/
   data-wait costs), and recommends a Young–Daly checkpoint interval
   from measured save cost + MTBF (docs/goodput.md).
+- ``tpu-ddp mem <run_dir>`` — memory truth loop: the live sampler's
+  per-host HBM timeline, measured high-water reconciled against the
+  recorded program's static plan (memplan convention) into a
+  measured-over-planned ratio per chip kind, fragmentation, and any
+  OOM postmortem bundles; ``--json`` is registry-recordable and the
+  tuner's HBM-cap calibration food (docs/memory.md).
 - ``tpu-ddp analyze [run_dir]`` — static step-time anatomy: XLA
   cost-model flops/bytes, collective inventory, roofline bound
   classification, per-strategy collective fingerprint; given a run dir,
@@ -57,7 +63,8 @@ Subcommands:
   short measured trials and re-ranks (docs/tuning.md).
 
 ``trace summarize``, ``health``, ``watch``, ``profile`` (modulo its
-lazy per-op join), ``registry``, and ``bench compare`` are stdlib-only
+lazy per-op join), ``mem`` (modulo its lazy plan rebuild; ``--no-plan``
+is import-free), ``registry``, and ``bench compare`` are stdlib-only
 end to end (no jax import): records are summarized wherever they land —
 a laptop, a CI box, the pod host itself. The train/launch/analyze
 subcommands import lazily so the read-back commands keep that property.
@@ -137,6 +144,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_ddp.ledger.report import main as goodput_main
 
         return goodput_main(argv[1:])
+    # mem is stdlib-only except the static-plan rebuild (lazy jax;
+    # --no-plan keeps it import-free)
+    if argv[:1] == ["mem"]:
+        from tpu_ddp.memtrack.report import main as mem_main
+
+        return mem_main(argv[1:])
     # registry is stdlib-only too (record/list/show/trend/diff)
     if argv[:1] == ["registry"]:
         from tpu_ddp.registry.cli import main as registry_main
@@ -199,6 +212,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="cross-incarnation goodput/badput ledger + Young–Daly "
              "checkpoint-interval advisor over a run dir "
              "(tpu-ddp goodput --help)",
+    )
+    sub.add_parser(
+        "mem",
+        help="memory truth loop over a run dir: live-HBM timeline, "
+             "measured-vs-planned reconciliation, OOM postmortems "
+             "(tpu-ddp mem --help)",
     )
     sub.add_parser(
         "registry",

@@ -131,9 +131,11 @@ class HealthMonitor:
             # mode "w" on the legacy name would truncate the dead life's
             # numerics record — the exact evidence a post-incident triage
             # needs — every time a run is resumed in the same dir
-            suffix = f".i{incarnation}" if incarnation else ""
+            from tpu_ddp.telemetry import sink_file_name
+
             path = os.path.join(
-                run_dir, f"health-p{process_index}{suffix}.jsonl")
+                run_dir,
+                sink_file_name("health", process_index, incarnation))
             self._fh = open(path, "w")
             self._write({
                 "schema_version": HEALTH_SCHEMA_VERSION,

@@ -44,6 +44,7 @@ def ledger_json(ledger: RunLedger) -> dict:
             "goodput_fraction": ledger.goodput_fraction,
             "category_seconds": dict(ledger.categories),
             "category_presence": ledger.category_presence,
+            "exit_counts": ledger.exit_counts,
             "incarnations": [e.to_json() for e in ledger.incarnations],
             "total_steps": ledger.total_steps,
             "replayed_steps": ledger.replayed_steps,

@@ -42,10 +42,15 @@ SPAN_BUCKETS = {
 }
 
 #: drain/exit evidence instants -> exit classification (checked in
-#: order; ``run_end`` alone means a clean finish, its absence a kill)
+#: order; ``run_end`` alone means a clean finish, its absence a kill).
+#: ``oom_abort`` (the Trainer's allocation-failure forensics,
+#: docs/memory.md) wins REGARDLESS of run_end: the re-raise path
+#: usually still flushes the sinks, but a runtime hard-killed mid-OOM
+#: must classify as oom too.
 _EXIT_INSTANTS = (
     ("preempt_drain", "preempted"),
     ("health_halt_drain", "health_halt"),
+    ("oom_abort", "oom"),
 )
 
 
@@ -60,7 +65,8 @@ class IncarnationRecord:
     end_wall: Optional[float] = None       # newest evidence, wall clock
     last_span_end_wall: Optional[float] = None
     exit: str = "killed"                   # clean | preempted |
-                                           # health_halt | hang | killed
+                                           # health_halt | hang | oom |
+                                           # killed
     buckets: Dict[str, float] = dataclasses.field(default_factory=dict)
     first_step: Optional[int] = None       # step BEFORE the first
                                            # compiled_step span (= the
@@ -212,7 +218,9 @@ def load_incarnation(index: int, files: Dict[int, str]) -> IncarnationRecord:
     rec.images = max(
         0.0, _counter(newest_counters, "train/images")
         - _counter(baseline, "train/images"))
-    if saw_run_end:
+    if exit_override == "oom":
+        rec.exit = "oom"  # evidence instant written before the re-raise
+    elif saw_run_end:
         rec.exit = exit_override or "clean"
     else:
         rec.exit = "hang" if saw_hang else "killed"

@@ -23,8 +23,9 @@ from tpu_ddp.ledger.advisor import mtbf_seconds, recommend_interval
 from tpu_ddp.ledger.stitch import StitchedRun
 
 #: exit classes that count as FAILURES for MTBF: the run did not choose
-#: to stop (preemption is the environment's choice, not the run's)
-FAILURE_EXITS = ("killed", "hang", "preempted")
+#: to stop (preemption is the environment's choice, not the run's;
+#: an OOM is the program hitting the HBM wall — docs/memory.md)
+FAILURE_EXITS = ("killed", "hang", "preempted", "oom")
 
 #: exit classes whose post-span tail is deliberate shutdown work (drain,
 #: final checkpoint, sink flush) rather than a dead process's silence
@@ -131,6 +132,18 @@ class RunLedger:
         return {name: 1 for name in CATEGORY_NAMES
                 if name != "productive"
                 and self.categories.get(name, 0.0) > 1e-9}
+
+    @property
+    def exit_counts(self) -> Dict[str, int]:
+        """{exit class: incarnation count} — ``bench compare`` gates
+        the FAILURE classes with union-of-keys semantics (REG003
+        style): a fresh ``oom``/``hang`` key appearing in a CI ledger
+        artifact is a regression exactly like a fresh badput
+        category, whatever the wall-clock says."""
+        out: Dict[str, int] = {}
+        for entry in self.incarnations:
+            out[entry.exit] = out.get(entry.exit, 0) + 1
+        return out
 
 
 def _per_incarnation(inc, prev, notes) -> IncarnationEntry:

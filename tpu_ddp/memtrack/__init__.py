@@ -1,0 +1,55 @@
+"""Memory truth loop: live HBM telemetry, plan reconciliation, OOM forensics.
+
+Every other axis of the observability arc is measured — time (analyze/
+profile), numerics (health), liveness (watch), cost (goodput/registry) —
+but memory was prediction-only: ``tools/memplan.py`` prices peak HBM
+statically and the tuner excludes candidates ``over_hbm`` on that model,
+while no subsystem ever read the chips' actual memory back. This package
+closes that loop (docs/memory.md):
+
+- ``sampler.py``  — per-step :class:`MemorySampler` riding in the Trainer
+  beside the watchdog beat: ``device.memory_stats()`` per local device
+  (live-array accounting on backends without it, e.g. CPU) into
+  ``memory/*`` gauges and a schema-versioned, incarnation-stamped
+  ``mem-p<i>[.i<k>].jsonl`` sink.
+- ``reconcile.py`` — joins the measured high-water against the static
+  plan (the memplan/``StepAnatomy`` peak of the run's RECORDED program,
+  rebuilt via ``anatomy_for_run_meta``) into a measured-over-planned
+  ratio per chip kind — the calibration food for the tuner's HBM cap.
+- ``postmortem.py`` — OOM forensics: the Trainer writes a one-shot
+  postmortem bundle (``<run_dir>/oom/step_<n>-p<i>/``) on
+  ``RESOURCE_EXHAUSTED`` before re-raising; the goodput ledger
+  classifies the exit as ``oom``.
+- ``report.py``   — ``tpu-ddp mem <run_dir>``: memory timeline
+  sparkline, measured-vs-planned table, fragmentation, postmortems;
+  ``--json`` is a registry-recordable artifact.
+
+``report``/``reconcile`` read-back is stdlib-only except the lazy plan
+rebuild (same degradation contract as ``watch --roofline``).
+"""
+
+from tpu_ddp.memtrack.postmortem import (
+    OOM_SCHEMA_VERSION,
+    is_resource_exhausted,
+    list_postmortems,
+    write_postmortem,
+)
+from tpu_ddp.memtrack.sampler import (
+    MEM_SCHEMA_VERSION,
+    MemorySampler,
+    host_rss_bytes,
+    mem_file_name,
+    publish_memory_gauges,
+)
+
+__all__ = [
+    "MEM_SCHEMA_VERSION",
+    "MemorySampler",
+    "OOM_SCHEMA_VERSION",
+    "host_rss_bytes",
+    "is_resource_exhausted",
+    "list_postmortems",
+    "mem_file_name",
+    "publish_memory_gauges",
+    "write_postmortem",
+]

@@ -1,0 +1,306 @@
+"""Per-step live memory sampler — the measured half of the HBM truth loop.
+
+The sampler rides in each training process beside the watchdog beat
+(``Trainer._run_loop``), dormant-when-disabled like the profiler's
+capture manager: one None-check per step when off, and when on it reads
+``device.memory_stats()`` for every LOCAL device — a host-side runtime
+call, no device sync — into:
+
+- the telemetry registry's ``memory/*`` gauges (scrapeable live via the
+  monitor exporter's ``/metrics``, snapshotted into the trace JSONL so
+  the fleet aggregator and MEM001 see them post-hoc too), and
+- a schema-versioned ``mem-p<i>[.i<k>].jsonl`` sink following the
+  incarnation-stamped naming grammar (``telemetry.sink_file_name``), so
+  a resumed run never truncates the dead life's memory record — the
+  exact evidence an OOM postmortem needs.
+
+Backends without ``memory_stats`` (CPU) fall back to live-array
+accounting: ``jax.live_arrays()`` bytes grouped per device. That
+measures the framework-visible resident buffers (params, optimizer
+state, batches) but NOT XLA's transient workspace, so CPU ratios
+under-measure the plan — the reconciliation report carries that
+degradation note (docs/memory.md). The high-water mark is tracked by
+the sampler itself where the backend reports no peak.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+#: bump on any breaking change to the mem JSONL record shape
+MEM_SCHEMA_VERSION = 1
+
+#: how many recent samples the in-process ring retains — the "last
+#: memory samples" evidence an OOM postmortem bundles
+RECENT_SAMPLES = 64
+
+
+def mem_file_name(process_index: int, incarnation: int = 0) -> str:
+    """``mem-p<i>[.i<k>].jsonl`` — the memory sink's view of the shared
+    incarnation-stamped naming grammar (``telemetry.sink_file_name``;
+    ``parse_sink_name`` is the inverse)."""
+    from tpu_ddp.telemetry import sink_file_name
+
+    return sink_file_name("mem", process_index, incarnation, "jsonl")
+
+
+def host_rss_bytes() -> Optional[int]:
+    """This process's resident set size in bytes: ``/proc/self/statm``
+    where it exists (Linux), ``ru_maxrss`` (a HIGH-water, KiB on Linux)
+    as the portable fallback, None when neither works."""
+    try:
+        with open("/proc/self/statm") as f:
+            fields = f.read().split()
+        return int(fields[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return None
+
+
+def _live_bytes_per_device() -> Dict[int, int]:
+    """Per-device resident bytes of every live jax array — the
+    framework-visible buffer accounting backends without
+    ``memory_stats`` get. Shard ``nbytes`` is metadata, so this never
+    materializes or syncs anything."""
+    import jax
+
+    per: Dict[int, int] = {}
+    for arr in jax.live_arrays():
+        try:
+            for shard in arr.addressable_shards:
+                dev = shard.data.devices().pop()
+                per[dev.id] = per.get(dev.id, 0) + int(shard.data.nbytes)
+        except Exception:
+            continue  # deleted/donated mid-iteration: skip, never raise
+    return per
+
+
+def sample_devices(devices=None,
+                   stats_fn: Optional[Callable] = None) -> List[dict]:
+    """One point-in-time per-device reading: ``{d, kind, bytes_in_use,
+    peak_bytes_in_use, bytes_limit, source}`` per local device.
+
+    ``stats_fn(device) -> dict | None`` is injectable (tests, synthetic
+    fleets); the default is ``device.memory_stats()``. Devices whose
+    stats come back empty fall back to live-array accounting (source
+    ``"live_arrays"``), computed once for the whole sample."""
+    import jax
+
+    devices = list(devices) if devices is not None else jax.local_devices()
+    read = stats_fn or (lambda d: d.memory_stats())
+    out: List[dict] = []
+    live: Optional[Dict[int, int]] = None
+    for i, d in enumerate(devices):
+        try:
+            stats = read(d) or {}
+        except Exception:
+            stats = {}
+        rec = {
+            "d": i,
+            "kind": getattr(d, "device_kind", "unknown"),
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+            "bytes_limit": stats.get("bytes_limit"),
+            "source": "memory_stats",
+        }
+        if rec["bytes_in_use"] is None:
+            if live is None:
+                live = _live_bytes_per_device()
+            rec["bytes_in_use"] = live.get(getattr(d, "id", i))
+            rec["source"] = "live_arrays"
+        out.append(rec)
+    return out
+
+
+def publish_memory_gauges(registry, device_samples: List[dict],
+                          rss: Optional[int] = None) -> None:
+    """Publish one sample into the telemetry registry — the ONE gauge
+    writer behind the sampler and ``metrics/memory.py``'s epoch-boundary
+    adapter, so the two can't drift:
+
+    - ``memory/d<i>/bytes_in_use``   per-device current residency
+    - ``memory/bytes_in_use_max``    worst chip current (the OOM
+      predictor's numerator-in-waiting)
+    - ``memory/high_water_bytes``    worst-chip peak (backend peak where
+      reported, else the worst current seen)
+    - ``memory/bytes_limit_per_device``  min limit (when the backend
+      reports one)
+    - ``memory/high_water_frac``     high-water / limit — MEM001's input
+    - ``memory/fragmentation_bytes`` worst per-device (peak − in_use):
+      the transient working set that exists only mid-step
+    - ``memory/host_rss_bytes``      host process residency (the only
+      series a stats-less backend would otherwise leave)
+    """
+    in_use, peaks, limits, frags = [], [], [], []
+    for rec in device_samples:
+        used = rec.get("bytes_in_use")
+        if isinstance(used, (int, float)):
+            registry.gauge(f"memory/d{rec.get('d')}/bytes_in_use").set(used)
+            in_use.append(used)
+        peak = rec.get("peak_bytes_in_use")
+        if isinstance(peak, (int, float)):
+            peaks.append(peak)
+            if isinstance(used, (int, float)):
+                frags.append(max(peak - used, 0))
+        limit = rec.get("bytes_limit")
+        if isinstance(limit, (int, float)):
+            limits.append(limit)
+    if in_use:
+        registry.gauge("memory/bytes_in_use_max").set(max(in_use))
+        # legacy alias (pre-memtrack scrape contract): the host total
+        registry.gauge("memory/bytes_in_use_total").set(sum(in_use))
+    high_water = max(peaks) if peaks else (max(in_use) if in_use else None)
+    if high_water is not None:
+        # monotone across the run: a gauge is last-write-wins, and the
+        # high-water must never move backwards on a backend that only
+        # reports the current residency
+        prev = registry.gauge("memory/high_water_bytes").value
+        high_water = max(high_water, prev or 0)
+        registry.gauge("memory/high_water_bytes").set(high_water)
+        # legacy alias (pre-memtrack scrape contract for the same fact)
+        registry.gauge("memory/peak_bytes_in_use_max").set(high_water)
+    if limits:
+        registry.gauge("memory/bytes_limit_per_device").set(min(limits))
+        if high_water is not None and min(limits) > 0:
+            registry.gauge("memory/high_water_frac").set(
+                high_water / min(limits))
+    if frags:
+        registry.gauge("memory/fragmentation_bytes").set(max(frags))
+    if rss is None:
+        rss = host_rss_bytes()
+    if rss is not None:
+        registry.gauge("memory/host_rss_bytes").set(rss)
+
+
+class MemorySampler:
+    """Per-step memory telemetry: gauges + the ``mem-p*`` JSONL sink.
+
+    Built by the Trainer exactly when telemetry is on (the sink lives in
+    the run dir); ``every`` > 1 strides the sampling for very hot loops.
+    ``on_step`` is the only per-step call; everything it does is
+    host-side metadata reads. ``recent()`` hands the OOM postmortem its
+    last-samples evidence."""
+
+    def __init__(
+        self,
+        run_dir: str,
+        *,
+        process_index: int = 0,
+        incarnation: int = 0,
+        telemetry=None,
+        every: int = 1,
+        run_meta: Optional[dict] = None,
+        devices=None,
+        stats_fn: Optional[Callable] = None,
+    ):
+        self.run_dir = run_dir
+        self.process_index = process_index
+        self.incarnation = incarnation
+        self.telemetry = telemetry
+        self.every = max(int(every), 1)
+        self._devices = devices
+        self._stats_fn = stats_fn
+        self._recent: deque = deque(maxlen=RECENT_SAMPLES)
+        self._lock = threading.Lock()
+        self._samples = 0
+        self._next_wall = 0.0   # duty-cycle gate (see on_step)
+        self._last_step: Optional[int] = None  # stride bookkeeping
+        os.makedirs(run_dir, exist_ok=True)
+        self.path = os.path.join(
+            run_dir, mem_file_name(process_index, incarnation))
+        self._fh = open(self.path, "w")
+        header = {
+            "type": "header",
+            "mem_schema_version": MEM_SCHEMA_VERSION,
+            "pid": process_index,
+            "incarnation": incarnation,
+            "epoch_unix": time.time(),
+        }
+        if run_meta:
+            header["run_meta"] = run_meta
+        self._write(header)
+
+    def _write(self, record: dict) -> None:
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()  # crash-safe like the trace sink: an OOM
+            # death must not take the evidence with it
+
+    def on_step(self, step: int) -> None:
+        """Sample if the stride (and the duty-cycle budget) say so.
+        Never raises — memory telemetry must not kill the training it
+        observes.
+
+        The budget: sampling may spend at most ~2% of wall-clock, so
+        after each sample the next one is gated ``50 × its cost`` away.
+        A real chip's ``memory_stats`` read is microseconds — the gate
+        never bites and the record is effectively per-step. The CPU
+        live-array fallback scales with the process's live-array count
+        (a long test session can reach tens of ms per scan), and this
+        is what keeps that pathology from taxing the very step loop the
+        sampler observes."""
+        # stride by boundary CROSSING, not `step % every == 0`: under
+        # scan fusion the step counter advances K at a time, and the
+        # modulo form would alias to lcm(K, every) — the same idiom the
+        # Trainer's --checkpoint-steps cadence uses
+        crossed = (self._last_step is None
+                   or (step // self.every) > (self._last_step // self.every))
+        self._last_step = step
+        if not crossed:
+            return
+        if time.time() < self._next_wall:
+            return
+        try:
+            t0 = time.perf_counter()
+            self.sample(step)
+            cost = time.perf_counter() - t0
+            self._next_wall = time.time() + min(cost * 50.0, 30.0)
+        except Exception:
+            pass
+
+    def sample(self, step: Optional[int] = None) -> dict:
+        """Take one sample now: write the JSONL record, refresh the
+        gauges, remember it in the ring. Returns the record."""
+        devices = sample_devices(self._devices, self._stats_fn)
+        rss = host_rss_bytes()
+        record = {
+            "schema_version": MEM_SCHEMA_VERSION,
+            "type": "mem",
+            "step": step,
+            "wall_time": time.time(),
+            "host_rss_bytes": rss,
+            "devices": devices,
+        }
+        self._recent.append(record)
+        self._samples += 1
+        self._write(record)
+        if self.telemetry is not None and self.telemetry.enabled:
+            publish_memory_gauges(self.telemetry.registry, devices, rss)
+        return record
+
+    def recent(self) -> List[dict]:
+        """The last ``RECENT_SAMPLES`` records, oldest first — the OOM
+        postmortem's sample evidence."""
+        return list(self._recent)
+
+    @property
+    def samples_taken(self) -> int:
+        return self._samples
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

@@ -1,6 +1,11 @@
 """Device memory diagnostics — the TPU-native replacement for the
 reference's dead GPUtil/numba GPU-cache hack (``main.py:67-78``). TPU HBM is
-managed by the XLA runtime; there is no cache to flush, only stats to read."""
+managed by the XLA runtime; there is no cache to flush, only stats to read.
+
+The gauge publishing routes through ``memtrack/sampler.py`` — the ONE
+writer of the ``memory/*`` gauge family — so this epoch-boundary adapter
+and the per-step live sampler (docs/memory.md) can never drift on names
+or semantics."""
 
 from __future__ import annotations
 
@@ -28,20 +33,14 @@ def device_memory_stats() -> list:
 
 
 def record_memory_gauges(registry) -> None:
-    """Thin adapter over the telemetry registry: publish the local devices'
-    HBM picture as gauges — worst-chip high-water (the OOM predictor),
-    current total in use, and the limit. No-op fields on backends without
-    memory_stats (CPU) are simply skipped."""
-    stats = device_memory_stats()
-    peaks = [s["peak_bytes_in_use"] for s in stats
-             if s["peak_bytes_in_use"] is not None]
-    in_use = [s["bytes_in_use"] for s in stats
-              if s["bytes_in_use"] is not None]
-    limits = [s["bytes_limit"] for s in stats
-              if s["bytes_limit"] is not None]
-    if peaks:
-        registry.gauge("memory/peak_bytes_in_use_max").set(max(peaks))
-    if in_use:
-        registry.gauge("memory/bytes_in_use_total").set(sum(in_use))
-    if limits:
-        registry.gauge("memory/bytes_limit_per_device").set(min(limits))
+    """Publish the local devices' memory picture as gauges: PER-DEVICE
+    ``memory/d<i>/bytes_in_use`` plus the worst-chip high-water (the OOM
+    predictor), current max, limit, fragmentation, and host RSS.
+
+    Backends without ``memory_stats`` (CPU) fall back to live-array
+    accounting + the host-RSS gauge instead of silently skipping — a CPU
+    CI run used to produce NO memory series at all, which is why nothing
+    downstream could be tested devicelessly."""
+    from tpu_ddp.memtrack.sampler import publish_memory_gauges, sample_devices
+
+    publish_memory_gauges(registry, sample_devices())

@@ -76,6 +76,14 @@ class MonitorConfig:
     data_wait_share_max: float = 0.5       # DWT001 threshold
     grad_norm_mad_threshold: float = 10.0  # NUM001: k over the norm window
     checkpoint_overdue_seconds: float = 0.0  # CKP001 (0 = rule disabled)
+    mem_limit_frac: float = 0.92           # MEM001: a host's measured
+                                           # HBM high-water above this
+                                           # fraction of the device
+                                           # limit fires (0 disables;
+                                           # only fires where the
+                                           # memory/* gauges exist, so
+                                           # the default is safe on
+                                           # stats-less backends)
     goodput_min_fraction: float = 0.0      # GDP001: fleet goodput gauge
                                            # below this fires (0 = rule
                                            # disabled — short runs are
@@ -98,6 +106,10 @@ class MonitorConfig:
             raise ValueError(
                 "goodput_min_fraction must be in [0, 1), got "
                 f"{self.goodput_min_fraction}")
+        if not 0.0 <= self.mem_limit_frac <= 1.0:
+            raise ValueError(
+                f"mem_limit_frac must be in [0, 1] (0 disables), got "
+                f"{self.mem_limit_frac}")
         if self.max_auto_profiles < 0:
             raise ValueError(
                 f"max_auto_profiles must be >= 0, got "
@@ -200,6 +212,7 @@ class HostSnapshot:
     lost: bool = False
     ended: bool = False   # clean shutdown (run_end marker): never "lost"
     health: Dict[str, object] = dataclasses.field(default_factory=dict)
+    memory: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -486,6 +499,23 @@ class FleetAggregator:
                         cfg.grad_norm_mad_threshold),
                     "last_anomaly": st.last_anomaly,
                 },
+                # the live sampler's memory/* gauges as snapshotted into
+                # the trace counters records (docs/memory.md) — MEM001's
+                # input; absent keys mean the run never sampled (or the
+                # backend reports no limit)
+                memory={
+                    key: st.gauges[gauge]
+                    for key, gauge in (
+                        ("high_water_bytes", "memory/high_water_bytes"),
+                        ("bytes_in_use_max", "memory/bytes_in_use_max"),
+                        ("bytes_limit", "memory/bytes_limit_per_device"),
+                        ("high_water_frac", "memory/high_water_frac"),
+                        ("fragmentation_bytes",
+                         "memory/fragmentation_bytes"),
+                        ("host_rss_bytes", "memory/host_rss_bytes"),
+                    )
+                    if isinstance(st.gauges.get(gauge), (int, float))
+                },
             ))
 
         for phase in ("compiled_step", "data_wait"):
@@ -537,6 +567,14 @@ class FleetAggregator:
                 st.gauges.get("goodput/fraction")
                 for st in self._hosts.values()
             ]),
+            # worst host's HBM high-water fraction: the fleet-level
+            # headroom figure the watch dashboard prints (MEM001 fires
+            # per host off the same gauge)
+            "hbm_high_water_frac": max(
+                (h.memory["high_water_frac"] for h in hosts
+                 if isinstance(h.memory.get("high_water_frac"),
+                               (int, float))),
+                default=None),
         }
         if ckpt_walls:
             wall, step_at = max(ckpt_walls, key=lambda t: t[0])

@@ -102,6 +102,17 @@ ALERT_RULES: Dict[str, Dict[str, str]] = {
                "the anomaly dump under <run_dir>/anomalies/ has the "
                "offending batch and stats",
     },
+    "MEM001": {
+        "title": "HBM headroom low",
+        "severity": "warning",
+        "kind": "threshold",
+        "fix": "a host's measured HBM high-water sits above the "
+               "configured fraction of the device limit: the next "
+               "allocation spike is an OOM — run `tpu-ddp mem "
+               "<run_dir>` for the measured-vs-planned breakdown, then "
+               "shrink the batch, enable --remat/--zero1, or re-run "
+               "`tpu-ddp tune` under the measured cap (docs/memory.md)",
+    },
     "CKP001": {
         "title": "checkpoint overdue",
         "severity": "warning",
@@ -239,6 +250,26 @@ class AlertEngine:
                     f"(> {cfg.grad_norm_mad_threshold:g}*MAD over its "
                     "rolling window)",
                     h.health.get("last_grad_norm"),
+                )
+
+            # MEM001: measured HBM high-water above the configured
+            # fraction of the device limit (the gauge pair the live
+            # memory sampler publishes, docs/memory.md). The high-water
+            # is monotone, so this naturally latches until the run ends.
+            frac = h.memory.get("high_water_frac")
+            if (cfg.mem_limit_frac > 0
+                    and isinstance(frac, (int, float))
+                    and frac > cfg.mem_limit_frac):
+                hw = h.memory.get("high_water_bytes")
+                limit = h.memory.get("bytes_limit")
+                found[("MEM001", h.host)] = (
+                    f"host {h.host} HBM high-water {frac:.0%} of the "
+                    f"device limit (> {cfg.mem_limit_frac:.0%}"
+                    + (f"; {hw:.0f}/{limit:.0f} B"
+                       if isinstance(hw, (int, float))
+                       and isinstance(limit, (int, float)) else "")
+                    + ") — `tpu-ddp mem` has the breakdown",
+                    float(frac),
                 )
 
             # latched, not edge-on-delta: NaNs never un-happen, so the

@@ -194,6 +194,12 @@ def render_report(report: dict) -> str:
         # the trainers' live goodput gauge (this incarnation only);
         # `tpu-ddp goodput` is the cross-incarnation truth
         fleet_bits.append(f"goodput {gf:.0%}")
+    hbm = fleet.get("hbm_high_water_frac")
+    if isinstance(hbm, (int, float)):
+        # worst host's measured HBM high-water over the device limit
+        # (the live memory sampler's gauge; MEM001 fires past the
+        # configured fraction — docs/memory.md)
+        fleet_bits.append(f"hbm {hbm:.0%}")
     rl = report.get("roofline") or {}
     if rl.get("mfu") is not None:
         fleet_bits.append(f"MFU {rl['mfu']:.1%}")
@@ -327,6 +333,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "goodput gauge falls below this fraction "
                          "(e.g. 0.5; short runs are legitimately "
                          "compile-bound, so the rule is opt-in)")
+    ap.add_argument("--mem-limit-frac", type=float, default=0.92,
+                    metavar="FRACTION",
+                    help="MEM001 fires when a host's measured HBM "
+                         "high-water exceeds this fraction of the "
+                         "device limit (0 disables; docs/memory.md)")
     ap.add_argument("--webhook", default=None, metavar="URL",
                     help="also POST every alert edge as JSON here")
     ap.add_argument("--no-alerts-file", action="store_true",
@@ -355,6 +366,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         data_wait_share_max=args.data_wait_max,
         checkpoint_overdue_seconds=args.checkpoint_overdue,
         goodput_min_fraction=args.goodput_min,
+        mem_limit_frac=args.mem_limit_frac,
         webhook_url=args.webhook,
         max_auto_profiles=args.max_auto_profiles,
     )

@@ -166,6 +166,8 @@ def _artifact_kind(art: dict) -> str:
         return "trace_summary"
     if "tune_schema_version" in art:
         return "tune"
+    if art.get("type") == "memtrack" or isinstance(art.get("mem"), dict):
+        return "mem"
     if isinstance(art.get("ledger"), dict):
         return "goodput_ledger"
     if isinstance(art.get("snapshot"), dict) and "alerts" in art:
@@ -190,6 +192,7 @@ def _find_run_id(art: dict) -> Optional[str]:
     for path in (("provenance", "run_id"),
                  ("run_meta", "run_id"),
                  ("ledger", "run_id"),
+                 ("mem", "run_id"),
                  ("snapshot", "run_id")):
         node: Any = art
         for k in path:
@@ -259,7 +262,7 @@ def _entry_provenance(art: dict, programs: Dict[str, dict],
             prov[key] = v
     # which schema the artifact itself declared (any of the families')
     for key in ("schema_version", "lint_schema_version",
-                "trace_summary_schema_version"):
+                "trace_summary_schema_version", "mem_schema_version"):
         if key in art:
             prov["artifact_schema_version"] = art[key]
             break

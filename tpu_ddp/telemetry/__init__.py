@@ -50,34 +50,56 @@ from tpu_ddp.telemetry.watchdog import HangWatchdog
 DEFAULT_SINKS = "jsonl,chrome,summary"
 
 
-def trace_file_name(process_index: int, incarnation: int = 0,
-                    kind: str = "jsonl") -> str:
-    """Per-host, per-incarnation sink filename. Incarnation 0 keeps the
-    legacy names (``trace-p<i>.jsonl``) so single-incarnation run dirs
-    look exactly as before; a resumed run's incarnation ``k`` writes
-    ``trace-p<i>.i<k>.jsonl`` instead of truncating the previous
-    incarnation's file — the previous life's spans are evidence the
-    goodput ledger stitches, not scratch to overwrite.
-    ``parse_trace_name`` is the inverse; keep them together."""
+def sink_file_name(prefix: str, process_index: int, incarnation: int = 0,
+                   ext: str = "jsonl") -> str:
+    """The per-host, per-incarnation sink naming grammar shared by every
+    file family a run writes (``trace`` / ``health`` / ``mem``):
+    ``<prefix>-p<i>[.i<k>].<ext>``. Incarnation 0 keeps the legacy
+    unstamped names so single-incarnation run dirs look exactly as
+    before; a resumed run's incarnation ``k`` stamps ``.i<k>`` instead
+    of truncating the previous incarnation's file — the previous life's
+    records are evidence the goodput ledger stitches, not scratch to
+    overwrite. ``parse_sink_name`` is the inverse; keep them together."""
     suffix = f".i{incarnation}" if incarnation else ""
-    ext = {"jsonl": "jsonl", "chrome": "trace.json"}[kind]
-    return f"trace-p{process_index}{suffix}.{ext}"
+    return f"{prefix}-p{process_index}{suffix}.{ext}"
 
 
-def parse_trace_name(name: str):
-    """Inverse of ``trace_file_name``: ``(process_index, incarnation,
-    kind)`` for a trace sink basename, None for anything else. The ONE
-    parser of the naming grammar — the ledger's incarnation discovery
-    and ``next_incarnation`` both route through it, so the writer and
-    its readers cannot drift."""
+def parse_sink_name(name: str, prefix: str = None):
+    """Inverse of ``sink_file_name``: ``(prefix, process_index,
+    incarnation, ext)`` for a sink basename, None for anything else (or
+    for a different family when ``prefix`` is given). The ONE parser of
+    the naming grammar — trace/health/mem discovery and
+    ``next_incarnation`` all route through it, so the writers and their
+    readers cannot drift."""
     import re
 
     m = re.match(
-        r"^trace-p(\d+)(?:\.i(\d+))?\.(jsonl|trace\.json)$", name)
+        r"^([a-z]+)-p(\d+)(?:\.i(\d+))?\.(jsonl|trace\.json)$", name)
     if not m:
         return None
-    kind = "jsonl" if m.group(3) == "jsonl" else "chrome"
-    return int(m.group(1)), int(m.group(2) or 0), kind
+    if prefix is not None and m.group(1) != prefix:
+        return None
+    return m.group(1), int(m.group(2)), int(m.group(3) or 0), m.group(4)
+
+
+def trace_file_name(process_index: int, incarnation: int = 0,
+                    kind: str = "jsonl") -> str:
+    """Trace-sink filename (``trace-p<i>[.i<k>].jsonl`` /
+    ``.trace.json``) — the trace family's view of the shared
+    :func:`sink_file_name` grammar."""
+    ext = {"jsonl": "jsonl", "chrome": "trace.json"}[kind]
+    return sink_file_name("trace", process_index, incarnation, ext)
+
+
+def parse_trace_name(name: str):
+    """``(process_index, incarnation, kind)`` for a trace sink basename,
+    None for anything else; routes through :func:`parse_sink_name` so
+    there is exactly one grammar parser."""
+    parsed = parse_sink_name(name, prefix="trace")
+    if parsed is None:
+        return None
+    _, pid, inc, ext = parsed
+    return pid, inc, "jsonl" if ext == "jsonl" else "chrome"
 
 
 def next_incarnation(run_dir, process_index: int = 0) -> int:
@@ -190,6 +212,8 @@ __all__ = [
     "DEFAULT_SINKS",
     "build_telemetry",
     "next_incarnation",
+    "parse_sink_name",
     "parse_trace_name",
+    "sink_file_name",
     "trace_file_name",
 ]

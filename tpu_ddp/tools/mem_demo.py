@@ -1,0 +1,363 @@
+"""``make mem-demo`` — end-to-end proof of the memory truth loop.
+
+The acceptance story ``tpu-ddp mem`` exists for, run as one live
+circuit on the 4-virtual-device CPU mesh (exit nonzero on any miss, so
+CI runs this beside tune-demo as a living gate):
+
+1. **A real run measures itself**: a short training run's per-step
+   sampler must produce per-device ``memory/*`` gauges scrapeable from
+   the LIVE ``/metrics`` endpoint mid-run AND an incarnation-stamped
+   ``mem-p0.jsonl`` record on disk.
+2. **The plan is reconciled by measurement**: ``tpu-ddp mem`` must join
+   the measured high-water against the recorded program's rebuilt
+   static peak, render the ratio, and carry the documented CPU
+   degradation note (live-array accounting under-measures the plan).
+3. **A near-limit fleet alarms**: a synthetic fleet with one host at
+   95% of the device limit must raise exactly MEM001 (and a clean
+   fleet none).
+4. **An OOM leaves forensics**: an injected ``RESOURCE_EXHAUSTED``
+   must yield a postmortem bundle (samples + config + run_meta + the
+   report-time plan with top buffers), a ``goodput`` ledger exit of
+   ``oom``, and a nonzero ``tpu-ddp mem`` exit.
+5. **The artifact archives**: ``mem --json`` must ``registry record``
+   as a mem-kind entry under ``$TPU_DDP_REGISTRY`` (when set).
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+import urllib.request
+
+
+def _fail(msg: str) -> None:
+    print(f"[mem-demo] FAIL: {msg}", file=sys.stderr)
+
+
+class _OOMAfter:
+    """Raise an allocation-failure-shaped error after N batches."""
+
+    def __init__(self, inner, n_batches):
+        self._inner, self._n = inner, n_batches
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __iter__(self):
+        for i, batch in enumerate(self._inner):
+            if i >= self._n:
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                    "allocate 68719476736 bytes (demo-injected)")
+            yield batch
+
+    def __len__(self):
+        return len(self._inner)
+
+
+class _SlowLoader:
+    """Small per-batch stall so the run lives long enough for a mid-run
+    /metrics scrape on any CI box."""
+
+    def __init__(self, inner, stall_s: float):
+        self._inner, self._stall_s = inner, stall_s
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __iter__(self):
+        for batch in self._inner:
+            time.sleep(self._stall_s)
+            yield batch
+
+    def __len__(self):
+        return len(self._inner)
+
+
+def _config(run_dir: str, **overrides):
+    from tpu_ddp.train.trainer import TrainConfig
+
+    base = dict(
+        synthetic_data=True,
+        synthetic_size=320,
+        epochs=1,
+        per_shard_batch=8,
+        model="netresdeep",
+        n_chans1=8,
+        n_blocks=2,
+        n_devices=4,
+        prefetch_depth=0,
+        log_every_epochs=1,
+        telemetry_dir=run_dir,
+        telemetry_sinks="jsonl",
+        telemetry_snapshot_steps=3,
+    )
+    base.update(overrides)
+    return TrainConfig(**base)
+
+
+def run_clean(run_dir: str) -> bool:
+    """A real run: per-device gauges scraped from the live /metrics,
+    mem record on disk afterwards."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tpu_ddp.train.trainer import Trainer
+
+    t = Trainer(_config(run_dir, monitor_port=-1))
+    t.train_loader = _SlowLoader(t.train_loader, 0.05)
+    done = threading.Event()
+
+    def run():
+        try:
+            t.run()
+        finally:
+            done.set()
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    scraped = None
+    endpoint = os.path.join(run_dir, "exporter-p0.json")
+    deadline = time.time() + 300
+    while not done.is_set() and time.time() < deadline:
+        try:
+            with open(endpoint) as f:
+                port = json.load(f)["port"]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=2
+            ).read().decode()
+            if "tpu_ddp_memory_d0_bytes_in_use" in body:
+                scraped = [line for line in body.splitlines()
+                           if line.startswith("tpu_ddp_memory_d")]
+                break
+        except Exception:
+            pass
+        time.sleep(0.1)
+    thread.join(timeout=600)
+    ok = True
+    if not done.is_set():
+        _fail("the run did not finish")
+        return False
+    if not scraped:
+        _fail("per-device memory gauges were never scrapeable from the "
+              "live /metrics")
+        ok = False
+    else:
+        print(f"[mem-demo] live scrape: {scraped[0]} "
+              f"(+{len(scraped) - 1} more memory series)")
+    if not os.path.isfile(os.path.join(run_dir, "mem-p0.jsonl")):
+        _fail("no mem-p0.jsonl record in the run dir")
+        ok = False
+    return ok
+
+
+def check_report(run_dir: str) -> bool:
+    """`tpu-ddp mem` on the clean run: exit 0, measured-vs-planned join
+    rendered with the documented CPU degradation note."""
+    from tpu_ddp.cli.main import main as cli_main
+    from tpu_ddp.memtrack.reconcile import CPU_DEGRADATION_NOTE
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["mem", run_dir])
+    out = buf.getvalue()
+    ok = True
+    if rc != 0:
+        _fail(f"tpu-ddp mem exited {rc} on the clean run")
+        ok = False
+    for needle in ("measured vs planned", "planned peak (args+temp)",
+                   "top planned buffers", CPU_DEGRADATION_NOTE):
+        if needle not in out:
+            _fail(f"report is missing {needle!r}")
+            ok = False
+    if ok:
+        ratio = [line for line in out.splitlines()
+                 if "measured / planned" in line]
+        print(f"[mem-demo] reconciliation: {ratio[0].strip()}")
+    return ok
+
+
+def check_mem001(scratch: str) -> bool:
+    """Synthetic fleets: one near-limit host raises exactly MEM001, a
+    clean fleet raises nothing."""
+    from tpu_ddp.monitor.aggregate import FleetAggregator, MonitorConfig
+    from tpu_ddp.monitor.alerts import AlertEngine
+
+    def fleet(dirname, fracs):
+        root = os.path.join(scratch, dirname)
+        shutil.rmtree(root, ignore_errors=True)
+        os.makedirs(root)
+        now = time.time()
+        limit = 16_000_000_000
+        for pid, frac in enumerate(fracs):
+            recs = [{"type": "header", "schema_version": 1,
+                     "epoch_unix": now - 60, "pid": pid,
+                     "run_meta": {"run_id": "memfleet",
+                                  "strategy": "dp",
+                                  "mesh": {"data": len(fracs)}}}]
+            for i in range(10):
+                recs.append({"type": "span", "name": "compiled_step",
+                             "ts_s": float(i), "dur_s": 0.5,
+                             "step": i, "depth": 0})
+            recs.append({
+                "type": "counters", "name": "counters_snapshot",
+                "ts_s": 11.0, "step": 10,
+                "attrs": {"gauges": {
+                    "memory/high_water_bytes": int(limit * frac),
+                    "memory/bytes_limit_per_device": limit,
+                    "memory/high_water_frac": frac,
+                }}})
+            with open(os.path.join(root, f"trace-p{pid}.jsonl"),
+                      "w") as f:
+                for r in recs:
+                    f.write(json.dumps(r) + "\n")
+            with open(os.path.join(root, f"heartbeat-p{pid}.json"),
+                      "w") as f:
+                json.dump({"wall_time": now, "step": 10}, f)
+        return root
+
+    ok = True
+    near = fleet("fleet_near_limit", [0.5, 0.5, 0.95, 0.5])
+    engine = AlertEngine(MonitorConfig(), run_dir=near, actions=(),
+                         once=True)
+    edges = engine.evaluate(
+        FleetAggregator(near, MonitorConfig()).poll())
+    fired = sorted((a.rule, a.host) for a in edges
+                   if a.state == "firing")
+    if fired != [("MEM001", 2)]:
+        _fail(f"near-limit fleet fired {fired}, expected exactly "
+              "[('MEM001', 2)]")
+        ok = False
+    clean = fleet("fleet_clean", [0.5, 0.55, 0.6, 0.5])
+    edges = AlertEngine(MonitorConfig(), run_dir=clean, actions=(),
+                        once=True).evaluate(
+        FleetAggregator(clean, MonitorConfig()).poll())
+    if [a for a in edges if a.state == "firing"]:
+        _fail(f"clean fleet fired {[(a.rule, a.host) for a in edges]}")
+        ok = False
+    if ok:
+        print("[mem-demo] MEM001: fires exactly on the 95% host, "
+              "clean fleet quiet")
+    return ok
+
+
+def run_oom(run_dir: str) -> bool:
+    """The injected OOM: postmortem bundle + ledger `oom` exit +
+    nonzero `tpu-ddp mem` exit."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tpu_ddp.cli.main import main as cli_main
+    from tpu_ddp.memtrack.postmortem import attach_plan, list_postmortems
+    from tpu_ddp.train.trainer import Trainer
+
+    t = Trainer(_config(run_dir))
+    t.train_loader = _OOMAfter(t.train_loader, 5)
+    try:
+        t.run()
+        _fail("the injected OOM never raised")
+        return False
+    except RuntimeError:
+        pass
+    ok = True
+    bundles = list_postmortems(run_dir)
+    if len(bundles) != 1:
+        _fail(f"expected exactly 1 postmortem bundle, got {len(bundles)}")
+        return False
+    b = bundles[0]
+    if not b["samples"]:
+        _fail("postmortem bundle carries no memory samples")
+        ok = False
+    if "RESOURCE_EXHAUSTED" not in (b.get("error") or ""):
+        _fail("postmortem bundle does not carry the allocation error")
+        ok = False
+    plan = attach_plan(b["path"])
+    if not plan or not plan.get("top_buffers"):
+        _fail("report-time plan attachment produced no top-buffer table")
+        ok = False
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["goodput", run_dir, "--json"])
+    if rc != 0:
+        _fail(f"tpu-ddp goodput exited {rc} on the OOM run")
+        return False
+    ledger = json.loads(buf.getvalue())["ledger"]
+    exits = [e["exit"] for e in ledger["incarnations"]]
+    if exits != ["oom"]:
+        _fail(f"ledger classified exits {exits}, expected ['oom']")
+        ok = False
+    if ledger["exit_counts"] != {"oom": 1}:
+        _fail(f"ledger exit_counts {ledger['exit_counts']}, expected "
+              "{'oom': 1}")
+        ok = False
+    with contextlib.redirect_stdout(io.StringIO()):
+        rc = cli_main(["mem", run_dir])
+    if rc != 1:
+        _fail(f"tpu-ddp mem exited {rc} on the OOM run, expected 1")
+        ok = False
+    if ok:
+        print(f"[mem-demo] OOM forensics: bundle at {b['path']}, "
+              "ledger exit 'oom', mem exit 1")
+    return ok
+
+
+def record_artifact(run_dir: str, scratch: str) -> bool:
+    """`mem --json` -> registry record (accumulates under
+    $TPU_DDP_REGISTRY when CI sets it)."""
+    from tpu_ddp.cli.main import main as cli_main
+    from tpu_ddp.registry.store import record_artifact, record_if_env
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["mem", run_dir, "--json"])
+    if rc != 0:
+        _fail(f"tpu-ddp mem --json exited {rc}")
+        return False
+    path = os.path.join(scratch, "mem_artifact.json")
+    with open(path, "w") as f:
+        f.write(buf.getvalue())
+    record_if_env(path, note="mem-demo clean-run memory report")
+    entry = record_artifact(os.path.join(scratch, "registry"), path)
+    if entry.artifact_kind != "mem":
+        _fail(f"registry classified the artifact as "
+              f"{entry.artifact_kind!r}, expected 'mem'")
+        return False
+    print(f"[mem-demo] registry: recorded mem entry {entry.entry_id} "
+          f"(digest {entry.config_digest})")
+    return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="memory truth-loop end-to-end demo (live gauges -> "
+                    "reconciliation -> MEM001 -> OOM forensics -> "
+                    "registry)")
+    ap.add_argument("--dir", required=True, help="scratch dir")
+    args = ap.parse_args(argv)
+    os.makedirs(args.dir, exist_ok=True)
+    clean_dir = os.path.join(args.dir, "clean")
+    oom_dir = os.path.join(args.dir, "oom")
+    shutil.rmtree(clean_dir, ignore_errors=True)
+    shutil.rmtree(oom_dir, ignore_errors=True)
+
+    ok = run_clean(clean_dir)
+    ok &= check_report(clean_dir)
+    ok &= check_mem001(args.dir)
+    ok &= run_oom(oom_dir)
+    ok &= record_artifact(clean_dir, args.dir)
+    if ok:
+        print("[mem-demo] OK: live per-device gauges -> measured-vs-"
+              "planned reconciliation -> MEM001 -> OOM postmortem + "
+              f"'oom' ledger exit; inspect with: tpu-ddp mem {clean_dir}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

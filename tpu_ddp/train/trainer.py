@@ -210,6 +210,17 @@ class TrainConfig:
                                           # step — attribution costs the
                                           # async-dispatch overlap
     telemetry_sinks: str = "jsonl,chrome,summary"  # comma-separated subset
+    mem_sample_steps: int = 1             # >0: per-step live memory
+                                          # sampler stride — device
+                                          # memory_stats (live-array
+                                          # accounting on CPU) into
+                                          # memory/* gauges + the
+                                          # incarnation-stamped
+                                          # mem-p<i>.jsonl sink, read
+                                          # back by `tpu-ddp mem`
+                                          # (docs/memory.md); 0 disables.
+                                          # Active exactly when
+                                          # telemetry_dir is set
     telemetry_snapshot_steps: int = 50    # >0: flush a counters snapshot
                                           # into the JSONL sink every N
                                           # steps — a killed/preempted run
@@ -296,6 +307,11 @@ class TrainConfig:
             raise ValueError(
                 "telemetry_snapshot_steps must be >= 0, got "
                 f"{self.telemetry_snapshot_steps}"
+            )
+        if self.mem_sample_steps < 0:
+            raise ValueError(
+                f"mem_sample_steps must be >= 0 (0 disables the memory "
+                f"sampler), got {self.mem_sample_steps}"
             )
         if self.checkpoint_steps < 0:
             raise ValueError(
@@ -621,6 +637,26 @@ class Trainer:
             window = parse_profile_steps(config.profile_steps)
             if window:
                 self._capture.arm_window(*window)
+
+        # Live memory sampler (docs/memory.md): per-step device
+        # memory_stats -> memory/* gauges + the incarnation-stamped
+        # mem-p<i>.jsonl sink. Exists exactly when telemetry does
+        # (dormant otherwise, like the capture manager); its ring of
+        # recent samples is the OOM postmortem's evidence.
+        self._memtrack = None
+        if config.telemetry_dir and config.mem_sample_steps > 0:
+            from tpu_ddp.memtrack.sampler import MemorySampler
+
+            local = set(jax.local_devices())
+            self._memtrack = MemorySampler(
+                config.telemetry_dir,
+                process_index=self.process_index,
+                incarnation=self.incarnation,
+                telemetry=self.telemetry,
+                every=config.mem_sample_steps,
+                run_meta=self.run_meta,
+                devices=[d for d in devices if d in local],
+            )
 
         self.model = build_model(config)
         self._load_data(train_data, test_data)
@@ -1306,6 +1342,8 @@ class Trainer:
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
+        if self._memtrack is not None:
+            self._memtrack.close()
         if self._health_monitor is not None:
             self._health_monitor.close()
 
@@ -1462,9 +1500,66 @@ class Trainer:
             old_handlers = {}
         try:
             return self._run_loop(c, start)
+        except Exception as e:
+            # OOM forensics (docs/memory.md): an XLA allocation failure
+            # at the step boundary writes a one-shot postmortem bundle
+            # (last memory samples, config, run_meta) and an oom_abort
+            # instant — the goodput ledger's `oom` exit evidence —
+            # BEFORE re-raising. Any other exception passes untouched.
+            self._handle_possible_oom(e)
+            raise
         finally:
             for sig, handler in old_handlers.items():
                 signal.signal(sig, handler)
+
+    def _handle_possible_oom(self, exc: BaseException) -> None:
+        """Classify + document an allocation-failure death; never raises
+        (forensics must not mask the original exception)."""
+        try:
+            from tpu_ddp.memtrack.postmortem import (
+                is_resource_exhausted,
+                write_postmortem,
+            )
+
+            if not is_resource_exhausted(exc):
+                return
+            c = self.config
+            step = int(getattr(self, "_last_host_step", 0) or 0)
+            samples = []
+            if self._memtrack is not None:
+                try:
+                    # one last reading at death: the state closest to
+                    # the wall (live-array accounting still works even
+                    # when the allocator is full — it only reads sizes)
+                    self._memtrack.sample(step)
+                except Exception:
+                    pass
+                samples = self._memtrack.recent()
+            path = None
+            if c.telemetry_dir:
+                path = write_postmortem(
+                    c.telemetry_dir,
+                    step=step,
+                    process_index=self.process_index,
+                    incarnation=self.incarnation,
+                    error=exc,
+                    samples=samples,
+                    config_snapshot=dataclasses.asdict(c),
+                    run_meta=self.run_meta,
+                )
+            tel = self.telemetry
+            if tel.enabled:
+                tel.count("memory/oom_events")
+                tel.instant("oom_abort", step=step,
+                            bundle=path, error=str(exc)[:300])
+            log.error(
+                "allocation failure at step %d (%s); %s",
+                step, type(exc).__name__,
+                (f"postmortem bundle -> {path}" if path else
+                 "no --telemetry-dir, postmortem bundle NOT written"),
+            )
+        except Exception:
+            pass
 
     def _preempt_agreed(self) -> bool:
         """Cross-host agreement on the preemption flag, evaluated at a
@@ -1597,6 +1692,7 @@ class Trainer:
                 tel.enabled
                 or self._watchdog is not None
                 or self._health_monitor is not None
+                or self._memtrack is not None
                 or (self.checkpointer is not None
                     and c.checkpoint_steps > 0)
             )
@@ -1635,6 +1731,9 @@ class Trainer:
                     host_step += (
                         self.steps_per_call if kind == "stacked" else 1
                     )
+                    # the step the OOM forensics stamp on a postmortem
+                    # bundle if this very dispatch exhausts HBM
+                    self._last_host_step = host_step
                 if tel.enabled:
                     # Attribution needs a per-step fence: "compiled_step"
                     # above is the async dispatch, "device_sync" is the
@@ -1670,6 +1769,10 @@ class Trainer:
                     # when it ends (boundaries snap to dispatch
                     # boundaries under scan fusion)
                     self._capture.on_step(host_step)
+                if self._memtrack is not None:
+                    # live memory sample (host-side runtime reads, no
+                    # device sync): memory/* gauges + mem-p<i>.jsonl
+                    self._memtrack.on_step(host_step)
                 if (self.checkpointer is not None and c.checkpoint_steps
                         and (host_step // c.checkpoint_steps)
                         > ((host_step

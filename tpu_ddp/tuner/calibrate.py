@@ -187,3 +187,80 @@ def calibration_for_chip(
         return Calibration()
     return Calibration(ratio=round(statistics.median(ratios), 4),
                        source="+".join(used), samples=len(ratios))
+
+
+# -- HBM-cap calibration (the memory truth loop's food) -------------------
+
+def _hbm_ratio_from_mem_record(rec: dict, chip_key: str) -> Optional[float]:
+    """One ``tpu-ddp mem`` record's measured-over-planned HBM ratio, or
+    None when it does not apply: wrong chip kind, no join, or NOT
+    ``calibratable`` — live-array-accounted (CPU) measurements
+    under-count the plan by the whole XLA workspace and must never
+    shrink a real chip's cap (docs/memory.md)."""
+    if not isinstance(rec, dict) or not rec.get("calibratable"):
+        return None
+    if _chip_key(rec.get("device_kind")) != chip_key:
+        return None
+    ratio = rec.get("measured_over_planned")
+    if isinstance(ratio, (int, float)) and ratio > 0:
+        return float(ratio)
+    return None
+
+
+def _hbm_ratio_from_artifact(path: str, chip_key: str) -> Optional[float]:
+    try:
+        with open(path) as f:
+            art = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    mem = art.get("mem")
+    if not isinstance(mem, dict):
+        return None
+    return _hbm_ratio_from_mem_record(mem, chip_key)
+
+
+def hbm_calibration_for_chip(
+    chip: str,
+    *,
+    sources: Sequence[str] = (),
+    registry_dir: Optional[str] = None,
+) -> Calibration:
+    """The per-chip measured-over-planned HBM ratio the capacity gate
+    multiplies into every candidate's compiled peak — the memory
+    analogue of :func:`calibration_for_chip`'s time ratio. Evidence:
+    ``tpu-ddp mem --json`` artifact files in ``sources`` and mem-kind
+    registry entries; the median wins, 1.0 with no evidence."""
+    chip_key = _chip_key(chip)
+    if chip_key is None:
+        raise ValueError(f"unknown chip {chip!r}")
+    ratios: List[float] = []
+    used: List[str] = []
+    for src in sources:
+        if os.path.isdir(src):
+            continue  # run dirs carry time evidence, not mem artifacts
+        one = _hbm_ratio_from_artifact(src, chip_key)
+        if one:
+            ratios.append(one)
+            used.append(os.path.basename(src) or src)
+    if registry_dir:
+        from tpu_ddp.registry.store import read_entries
+
+        try:
+            entries = read_entries(registry_dir)
+        except (OSError, ValueError):
+            entries = []
+        found = []
+        for entry in entries:
+            if entry.artifact_kind != "mem":
+                continue
+            one = _hbm_ratio_from_mem_record(
+                (entry.programs or {}).get("mem") or {}, chip_key)
+            if one:
+                found.append(one)
+        if found:
+            ratios.extend(found)
+            used.append(f"registry:{registry_dir}")
+    if not ratios:
+        return Calibration()
+    return Calibration(ratio=round(statistics.median(ratios), 4),
+                       source="+".join(used), samples=len(ratios))

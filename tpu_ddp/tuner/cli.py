@@ -156,7 +156,9 @@ def render_result(result, *, top: int = 0) -> str:
         f"devices={result.n_devices} dtype={result.compute_dtype} "
         f"(compiled {result.compiled_programs} distinct programs, "
         f"calibration x{result.calibration_ratio:g} "
-        f"[{result.calibration_source}])",
+        f"[{result.calibration_source}], hbm "
+        f"x{result.hbm_calibration_ratio:g} "
+        f"[{result.hbm_calibration_source}])",
         "",
     ]
     rows = result.ranked[:top] if top else result.ranked
@@ -211,6 +213,8 @@ def tune_artifact(result) -> dict:
         "dispatch_overhead_us": round(result.dispatch_overhead_s * 1e6, 1),
         "calibration": {"ratio": result.calibration_ratio,
                         "source": result.calibration_source},
+        "hbm_calibration": {"ratio": result.hbm_calibration_ratio,
+                            "source": result.hbm_calibration_source},
         "grid": result.grid_descriptor(),
         "n_candidates": len(result.ranked) + len(result.excluded),
         "n_ranked": len(result.ranked),
@@ -307,12 +311,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="roofline overlap assumption")
     ap.add_argument("--calibrate-from", action="append", default=[],
                     metavar="PATH",
-                    help="run dir (profile bundles) or analyze --json "
-                         "artifact to read measured-over-predicted "
-                         "calibration from (repeatable)")
+                    help="run dir (profile bundles), analyze --json "
+                         "artifact (time calibration), or mem --json "
+                         "artifact (measured HBM-cap calibration) to "
+                         "read measured-over-predicted ratios from "
+                         "(repeatable)")
     ap.add_argument("--registry", default=None, metavar="DIR",
                     help="perf-registry workspace: archived validated "
-                         "tune entries join the calibration evidence")
+                         "tune entries join the time calibration, "
+                         "mem-kind entries the HBM-cap calibration")
     ap.add_argument("--top", type=int, default=15,
                     help="ranked rows to print (0 = all)")
     ap.add_argument("--json", default=None,
@@ -389,6 +396,13 @@ def _run(args) -> int:
                          "--strategies against the model family)")
     calibration = calibration_for_chip(
         chip, sources=args.calibrate_from, registry_dir=args.registry)
+    # HBM-cap calibration (docs/memory.md): `tpu-ddp mem --json`
+    # artifacts in --calibrate-from and mem-kind registry entries feed
+    # the measured-over-planned peak ratio into the capacity gate
+    from tpu_ddp.tuner.calibrate import hbm_calibration_for_chip
+
+    hbm_calibration = hbm_calibration_for_chip(
+        chip, sources=args.calibrate_from, registry_dir=args.registry)
     print(f"tpu-ddp tune: {len(candidates)} candidates "
           f"({len({c.program_key() for c in candidates})} distinct "
           f"programs) for {model_label} on {n}x {spec.key}", flush=True)
@@ -399,6 +413,8 @@ def _run(args) -> int:
         num_classes=args.num_classes,
         calibration_ratio=calibration.ratio,
         calibration_source=calibration.source,
+        hbm_calibration_ratio=hbm_calibration.ratio,
+        hbm_calibration_source=hbm_calibration.source,
         dispatch_overhead_s=(
             args.dispatch_overhead_us * 1e-6
             if args.dispatch_overhead_us is not None

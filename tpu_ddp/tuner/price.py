@@ -129,6 +129,11 @@ class TuneResult:
     compiled_programs: int
     image_size: int = 32
     overlap: str = "overlapped"
+    # HBM-cap calibration (docs/memory.md): measured-over-planned peak
+    # from `tpu-ddp mem` evidence, multiplied into every candidate's
+    # compiled peak before the over_hbm verdict
+    hbm_calibration_ratio: float = 1.0
+    hbm_calibration_source: str = "none"
 
     @property
     def winner(self) -> Optional[PricedCandidate]:
@@ -151,6 +156,7 @@ class TuneResult:
             "dispatch_overhead_us": round(
                 self.dispatch_overhead_s * 1e6, 1),
             "calibration_ratio": self.calibration_ratio,
+            "hbm_calibration_ratio": self.hbm_calibration_ratio,
         }
 
 
@@ -231,6 +237,7 @@ def price_anatomy(
     chip: str,
     n_devices: int,
     calibration_ratio: float = 1.0,
+    hbm_calibration_ratio: float = 1.0,
     dispatch_overhead_s: float = DEFAULT_DISPATCH_OVERHEAD_S,
     overlap: str = "overlapped",
     lint_rule_counts: Optional[Dict[str, int]] = None,
@@ -239,7 +246,13 @@ def price_anatomy(
     """The pure pricing tail over an already-extracted anatomy: lint
     verdict -> HBM cap -> roofline -> calibration -> dispatch
     amortization -> throughput. Split out so tests can price synthetic
-    anatomies without compiling."""
+    anatomies without compiling.
+
+    ``hbm_calibration_ratio`` is the measured-over-planned peak from
+    the memory truth loop (``tpu-ddp mem``, docs/memory.md): the
+    capacity gate checks ``peak * ratio`` against the chip's HBM, so a
+    chip kind whose measured high-water runs hot against the static
+    plan excludes borderline candidates BEFORE they OOM on hardware."""
     from tpu_ddp.analysis.roofline import chip_spec, roofline
 
     name = cand.name(n_devices)
@@ -257,12 +270,18 @@ def price_anatomy(
             "CHIP_SPECS key (v2..v6e)"
         )
     peak = anatomy.peak_bytes
-    hbm_fraction = (peak / spec.hbm_bytes
-                    if peak is not None and spec.hbm_bytes else None)
+    expected_peak = (peak * hbm_calibration_ratio
+                     if peak is not None else None)
+    hbm_fraction = (expected_peak / spec.hbm_bytes
+                    if expected_peak is not None and spec.hbm_bytes
+                    else None)
     if hbm_fraction is not None and hbm_fraction >= 1.0:
+        calibrated = (f" (x{hbm_calibration_ratio:g} measured HBM "
+                      "calibration)" if hbm_calibration_ratio != 1.0
+                      else "")
         return PricedCandidate(
             candidate=cand, name=name, status=STATUS_OVER_HBM,
-            reason=(f"compiled peak (args+temp) {peak} B is "
+            reason=(f"compiled peak (args+temp) {peak} B{calibrated} is "
                     f"{hbm_fraction:.2f}x the {spec.key} HBM capacity "
                     f"({spec.hbm_bytes} B)"),
             peak_bytes=peak, hbm_fraction=round(hbm_fraction, 4),
@@ -307,6 +326,8 @@ def tune(
     num_classes: int = 10,
     calibration_ratio: float = 1.0,
     calibration_source: str = "none",
+    hbm_calibration_ratio: float = 1.0,
+    hbm_calibration_source: str = "none",
     dispatch_overhead_s: float = DEFAULT_DISPATCH_OVERHEAD_S,
     overlap: str = "overlapped",
     lint_config=None,
@@ -362,6 +383,7 @@ def tune(
         priced = price_anatomy(
             cand, audit.anatomy, chip=chip, n_devices=n,
             calibration_ratio=calibration_ratio,
+            hbm_calibration_ratio=hbm_calibration_ratio,
             dispatch_overhead_s=dispatch_overhead_s, overlap=overlap,
             lint_rule_counts=rule_counts(findings), lint_errors=errors,
         )
@@ -374,6 +396,8 @@ def tune(
         dispatch_overhead_s=dispatch_overhead_s,
         calibration_ratio=calibration_ratio,
         calibration_source=calibration_source,
+        hbm_calibration_ratio=hbm_calibration_ratio,
+        hbm_calibration_source=hbm_calibration_source,
         ranked=ranked, excluded=excluded,
         compiled_programs=len(audits),
         image_size=image_size, overlap=overlap,
