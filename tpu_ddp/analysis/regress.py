@@ -17,7 +17,7 @@ fusion choices and temp bytes is real). Wall-clock fields
 (``compile_wall_s``) are reported, never gated — they measure the build
 machine, not the program.
 
-Understands seven artifact shapes: ``benchmarks/aot_v5e.json``-style
+Understands eight artifact shapes: ``benchmarks/aot_v5e.json``-style
 (``{"programs": {name: record}}``), ``tpu-ddp analyze --json`` output
 (``{"anatomy": ...}``), ``tpu-ddp goodput --json`` ledgers
 (``{"ledger": ...}`` — badput category presence AND failure-exit
@@ -29,7 +29,11 @@ higher-is-better quality metric, its predicted step time as a size),
 peak and measured high-water gate as sizes, a fresh ``oom_count``
 exactly), ``tpu-ddp trace summarize --json`` run summaries (measured
 phase percentiles: report-only here, trend-gated by the registry),
-and a bare single program record.
+``tpu-ddp curves --json`` learning curves (``{"curve": ...}`` — the
+final eval accuracy gates as a higher-is-better quality metric, the
+final eval loss and time-to-target steps as unit-scale sizes, and CRV
+rule counts exactly through the shared rule-count channel), and a
+bare single program record.
 Stdlib-only — no jax import — so it gates anywhere the JSON lands.
 
 ``--against <registry-dir>`` replaces the hand-pointed baseline file
@@ -51,8 +55,14 @@ _SIZE_KEYS = (
     "argument_bytes", "output_bytes", "temp_bytes", "peak_bytes",
     "flops", "bytes_accessed", "predicted_step_us",
     "measured_high_water_bytes",
+    "time_to_target_steps", "final_eval_loss",
 )
 _SIZE_NOISE_FLOOR = 1024
+
+#: sized keys at UNIT scale (a loss ~2.3, a step count ~100): the 1 KiB
+#: byte-noise floor would swallow them entirely, so these gate on the
+#: relative tolerance alone
+_UNIT_SIZE_KEYS = ("time_to_target_steps", "final_eval_loss")
 
 #: count metrics (exact): any increase is a regression
 _COUNT_KEYS = ("s8_collective_permute_count", "f32_collective_permute_count",
@@ -80,10 +90,12 @@ _SOFT_COUNT_KEYS = ("fusion_count",)
 _WALL_KEYS = ("compile_wall_s", "elapsed_s")
 
 #: HIGHER-is-better metrics (the goodput ledger's headline fraction,
-#: and the tuner's predicted winner throughput): a relative drop beyond
-#: tolerance is a regression, a rise an improvement — mirroring the
-#: sized-metric gate with the sign flipped
-_QUALITY_KEYS = ("goodput_fraction", "predicted_images_per_sec_per_chip")
+#: the tuner's predicted winner throughput, and a learning curve's
+#: final eval accuracy): a relative drop beyond tolerance is a
+#: regression, a rise an improvement — mirroring the sized-metric gate
+#: with the sign flipped
+_QUALITY_KEYS = ("goodput_fraction", "predicted_images_per_sec_per_chip",
+                 "final_eval_accuracy")
 
 
 def load_artifact(path: str) -> Dict[str, dict]:
@@ -122,6 +134,12 @@ def normalize_artifact(art, path: str = "<artifact>") -> Dict[str, dict]:
         # as sizes, a fresh oom_count gates exactly; the measured-over-
         # planned ratio is calibration food, not a gate
         return {"mem": art["mem"]}
+    if isinstance(art.get("curve"), dict):
+        # `tpu-ddp curves --json`: final eval accuracy gates as quality,
+        # final eval loss / time-to-target as unit-scale sizes, and the
+        # CRV rule counts exactly (the shared rule-count channel — a
+        # fresh CRV finding regresses like a new lint finding)
+        return {"curves": art["curve"]}
     if art.get("type") == "trace_summary" and isinstance(
             art.get("phases"), dict):
         # `tpu-ddp trace summarize --json`: measured per-phase
@@ -309,17 +327,25 @@ def compare(old: Dict[str, dict], new: Dict[str, dict],
                 if key.startswith("inventory/") and new_has_inventory:
                     improvements.append(f"{name}: {key}: gone")
                 continue
-            if nv > ov + _SIZE_NOISE_FLOOR and nv > ov * (1 + tolerance):
+            unit = key in _UNIT_SIZE_KEYS
+            floor = 0.0 if unit else _SIZE_NOISE_FLOOR
+
+            def fmt(v: float) -> str:
+                # unit-scale metrics (a loss) need decimals; byte/flop
+                # counts stay integral
+                return f"{v:.4g}" if unit else f"{v:.0f}"
+
+            if nv > ov + floor and nv > ov * (1 + tolerance):
                 # ov can be 0 (e.g. a wire_bytes entry whose groups failed
                 # to parse): still a regression, just no percent to quote
                 delta = (f"+{(nv - ov) / ov:.1%}" if ov else "from 0")
                 regressions.append(
-                    f"{name}: {key}: {ov:.0f} -> {nv:.0f} "
+                    f"{name}: {key}: {fmt(ov)} -> {fmt(nv)} "
                     f"({delta}, tolerance {tolerance:.0%})"
                 )
-            elif ov > nv + _SIZE_NOISE_FLOOR and ov > nv * (1 + tolerance):
+            elif ov > nv + floor and ov > nv * (1 + tolerance):
                 improvements.append(
-                    f"{name}: {key}: {ov:.0f} -> {nv:.0f} "
+                    f"{name}: {key}: {fmt(ov)} -> {fmt(nv)} "
                     f"(-{(ov - nv) / ov:.1%})"
                 )
         for key in _QUALITY_KEYS:

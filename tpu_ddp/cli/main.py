@@ -26,6 +26,14 @@ Subcommands:
   taxonomy (restart gaps, replayed steps, stalls, checkpoint/compile/
   data-wait costs), and recommends a Young–Daly checkpoint interval
   from measured save cost + MTBF (docs/goodput.md).
+- ``tpu-ddp curves <run_dir>`` — convergence observatory: extract the
+  run's learning curve (per-step loss/grad-norm from the health sinks
+  across every incarnation, the eval-instant history from the trace);
+  ``--against <registry>`` judges it against the seed band of archived
+  baseline runs sharing its seed-invariant quality digest (CRV001-004
+  findings, exit 1 on any); ``tpu-ddp curves diff A B`` is the
+  step-aligned overlay-parity verdict ``make compress-demo`` gates on
+  (docs/curves.md).
 - ``tpu-ddp mem <run_dir>`` — memory truth loop: the live sampler's
   per-host HBM timeline, measured high-water reconciled against the
   recorded program's static plan (memplan convention) into a
@@ -64,7 +72,8 @@ Subcommands:
 
 ``trace summarize``, ``health``, ``watch``, ``profile`` (modulo its
 lazy per-op join), ``mem`` (modulo its lazy plan rebuild; ``--no-plan``
-is import-free), ``registry``, and ``bench compare`` are stdlib-only
+is import-free), ``curves``, ``registry``, and ``bench compare`` are
+stdlib-only
 end to end (no jax import): records are summarized wherever they land —
 a laptop, a CI box, the pod host itself. The train/launch/analyze
 subcommands import lazily so the read-back commands keep that property.
@@ -150,6 +159,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_ddp.memtrack.report import main as mem_main
 
         return mem_main(argv[1:])
+    # curves is stdlib-only end to end (file archaeology + band math)
+    if argv[:1] == ["curves"]:
+        from tpu_ddp.curves.report import main as curves_main
+
+        return curves_main(argv[1:])
     # registry is stdlib-only too (record/list/show/trend/diff)
     if argv[:1] == ["registry"]:
         from tpu_ddp.registry.cli import main as registry_main
@@ -218,6 +232,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="memory truth loop over a run dir: live-HBM timeline, "
              "measured-vs-planned reconciliation, OOM postmortems "
              "(tpu-ddp mem --help)",
+    )
+    sub.add_parser(
+        "curves",
+        help="learning-curve extraction + seed-band trajectory gating "
+             "over a run dir; `curves diff A B` for overlay parity "
+             "(tpu-ddp curves --help)",
     )
     sub.add_parser(
         "registry",

@@ -88,6 +88,18 @@ class MonitorConfig:
                                            # below this fires (0 = rule
                                            # disabled — short runs are
                                            # legitimately compile-bound)
+    loss_plateau_window: int = 0           # TRN001: recorded loss points
+                                           # over which "no meaningful
+                                           # improvement" fires (0 =
+                                           # rule disabled — a converged
+                                           # run legitimately plateaus;
+                                           # opt in near the end of a
+                                           # warmup or during an overlay
+                                           # canary, docs/curves.md)
+    loss_plateau_rel_delta: float = 0.01   # TRN001: the loss must have
+                                           # improved by at least this
+                                           # fraction of its level over
+                                           # the window, else plateau
     webhook_url: Optional[str] = None      # alert webhook action target
     max_auto_profiles: int = 3             # capture_profile action: alert-
                                            # armed profiler captures per run
@@ -106,6 +118,15 @@ class MonitorConfig:
             raise ValueError(
                 "goodput_min_fraction must be in [0, 1), got "
                 f"{self.goodput_min_fraction}")
+        if self.loss_plateau_window != 0 and self.loss_plateau_window < 8:
+            raise ValueError(
+                "loss_plateau_window must be 0 (disabled) or >= 8 "
+                "(the verdict medians two window halves), got "
+                f"{self.loss_plateau_window}")
+        if self.loss_plateau_rel_delta < 0:
+            raise ValueError(
+                "loss_plateau_rel_delta must be >= 0, got "
+                f"{self.loss_plateau_rel_delta}")
         if not 0.0 <= self.mem_limit_frac <= 1.0:
             raise ValueError(
                 f"mem_limit_frac must be in [0, 1] (0 disables), got "

@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import math
 import os
 import statistics
 from collections import deque
@@ -112,6 +113,18 @@ ALERT_RULES: Dict[str, Dict[str, str]] = {
                "<run_dir>` for the measured-vs-planned breakdown, then "
                "shrink the batch, enable --remat/--zero1, or re-run "
                "`tpu-ddp tune` under the measured cap (docs/memory.md)",
+    },
+    "TRN001": {
+        "title": "loss plateau",
+        "severity": "warning",
+        "kind": "trend",
+        "fix": "the training loss has stopped improving over the "
+               "configured window (opt-in: --loss-plateau-window): "
+               "check the lr schedule (warmup over? decay kicked in "
+               "too early?), then judge the trajectory against its "
+               "seed band with `tpu-ddp curves <run_dir> --against "
+               "<registry>` (docs/curves.md) — an expected convergence "
+               "plateau resolves by disabling the rule",
     },
     "CKP001": {
         "title": "checkpoint overdue",
@@ -301,6 +314,32 @@ class AlertEngine:
             if (("THR001", None) not in found
                     and ("THR001", None) not in self._active):
                 self._rate_baseline.append(rate)
+
+        # TRN001 — loss plateau (opt-in, fleet-scoped: the health loss
+        # series is a replicated global). Compared as median(first half)
+        # vs median(second half) of the newest window: robust to single-
+        # step jitter, and it RESOLVES as soon as the loss starts moving
+        # again (or latches through a whole converged tail — which is
+        # why the rule is opt-in).
+        w = cfg.loss_plateau_window
+        if w > 0:
+            series = [v for v in (snap.loss_series or [])
+                      if isinstance(v, (int, float)) and math.isfinite(v)]
+            if len(series) >= w:
+                recent = series[-w:]
+                first = statistics.median(recent[:w // 2])
+                second = statistics.median(recent[w // 2:])
+                level = max(abs(first), 1e-8)
+                improvement = (first - second) / level
+                if improvement < cfg.loss_plateau_rel_delta:
+                    found[("TRN001", None)] = (
+                        f"loss plateaued: improved {improvement:.2%} "
+                        f"over the last {w} recorded points (< "
+                        f"{cfg.loss_plateau_rel_delta:.2%} of its "
+                        f"level {first:.4g}) — is this convergence or "
+                        "a dead schedule?",
+                        improvement,
+                    )
 
         if cfg.goodput_min_fraction > 0:
             gf = snap.fleet.get("goodput_fraction")

@@ -333,6 +333,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "goodput gauge falls below this fraction "
                          "(e.g. 0.5; short runs are legitimately "
                          "compile-bound, so the rule is opt-in)")
+    ap.add_argument("--loss-plateau-window", type=int, default=0,
+                    metavar="N",
+                    help=">0: TRN001 fires when the loss improved less "
+                         "than --loss-plateau-delta over the last N "
+                         "recorded points (opt-in — a converged run "
+                         "legitimately plateaus; docs/curves.md)")
+    ap.add_argument("--loss-plateau-delta", type=float, default=0.01,
+                    metavar="FRACTION",
+                    help="TRN001: minimum fractional loss improvement "
+                         "over the window that counts as progress")
     ap.add_argument("--mem-limit-frac", type=float, default=0.92,
                     metavar="FRACTION",
                     help="MEM001 fires when a host's measured HBM "
@@ -366,6 +376,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         data_wait_share_max=args.data_wait_max,
         checkpoint_overdue_seconds=args.checkpoint_overdue,
         goodput_min_fraction=args.goodput_min,
+        loss_plateau_window=args.loss_plateau_window,
+        loss_plateau_rel_delta=args.loss_plateau_delta,
         mem_limit_frac=args.mem_limit_frac,
         webhook_url=args.webhook,
         max_auto_profiles=args.max_auto_profiles,

@@ -166,6 +166,13 @@ def _artifact_kind(art: dict) -> str:
         return "trace_summary"
     if "tune_schema_version" in art:
         return "tune"
+    if "curves_schema_version" in art or isinstance(
+            art.get("curve"), dict):
+        # `tpu-ddp curves --json`: the seed-band baseline pool
+        # (docs/curves.md) — its embedded provenance keys the series on
+        # the seed-invariant quality digest, so N seeded runs of one
+        # recipe land in ONE series
+        return "curves"
     if art.get("type") == "memtrack" or isinstance(art.get("mem"), dict):
         return "mem"
     if isinstance(art.get("ledger"), dict):
@@ -193,6 +200,7 @@ def _find_run_id(art: dict) -> Optional[str]:
                  ("run_meta", "run_id"),
                  ("ledger", "run_id"),
                  ("mem", "run_id"),
+                 ("curve", "run_id"),
                  ("snapshot", "run_id")):
         node: Any = art
         for k in path:

@@ -22,6 +22,7 @@ any machine. See ``docs/telemetry.md``.
 
 from tpu_ddp.telemetry.core import NULL, Telemetry
 from tpu_ddp.telemetry.events import (
+    EVAL_POINT_SCHEMA_VERSION,
     RUN_META_SCHEMA_VERSION,
     SCHEMA_VERSION,
     Clock,
@@ -32,6 +33,7 @@ from tpu_ddp.telemetry.provenance import (
     artifact_provenance,
     config_digest,
     git_provenance,
+    quality_digest,
 )
 from tpu_ddp.telemetry.registry import (
     Registry,
@@ -197,10 +199,12 @@ __all__ = [
     "Event",
     "SCHEMA_VERSION",
     "RUN_META_SCHEMA_VERSION",
+    "EVAL_POINT_SCHEMA_VERSION",
     "PROVENANCE_SCHEMA_VERSION",
     "artifact_provenance",
     "config_digest",
     "git_provenance",
+    "quality_digest",
     "Registry",
     "default_registry",
     "reset_default_registry",

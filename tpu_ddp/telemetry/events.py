@@ -28,6 +28,12 @@ SCHEMA_VERSION = 1
 #: changes; ``tpu-ddp analyze`` refuses metadata from the future.
 RUN_META_SCHEMA_VERSION = 1
 
+#: Version of the ``eval`` instant's attrs (the step/epoch-anchored eval
+#: point the Trainer emits into the trace per evaluation — the durable
+#: eval HISTORY that used to die as latest-value gauges). Bump on
+#: breaking changes; ``tpu_ddp/curves`` refuses points from the future.
+EVAL_POINT_SCHEMA_VERSION = 1
+
 # Event kinds
 SPAN = "span"          # a named phase with a duration
 INSTANT = "instant"    # a point event (trace written, watchdog fired, ...)

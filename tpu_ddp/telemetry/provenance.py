@@ -88,10 +88,68 @@ def config_digest(obj: Any) -> str:
     ).hexdigest()[:10]
 
 
+#: TrainConfig keys excluded from :func:`quality_digest`: the RNG seed
+#: (different seeds of one recipe must form ONE seed-band series) and
+#: every run-local knob — filesystem paths, resume/observability wiring —
+#: that changes between launches without changing what the run LEARNS.
+#: Learning-relevant knobs (lr, batch, model, overlays, dtype, ...) stay
+#: in; two configs that differ only in these keys train interchangeable
+#: trajectories by construction.
+QUALITY_DIGEST_EXCLUDED = (
+    "seed",
+    "resume",
+    # run-local paths
+    "data_dir",
+    "checkpoint_dir",
+    "health_dir",
+    "telemetry_dir",
+    "jsonl_path",
+    "tensorboard_dir",
+    "profile_dir",
+    "compilation_cache_dir",
+    "plot_curves",
+    "dump_predictions",
+    # run-local observability/process wiring (no effect on the update rule)
+    "download",
+    "monitor_port",
+    "monitor_bind",
+    "monitor_allow_remote_trigger",
+    "profile_steps",
+    "profile_window_steps",
+    "profile_host_hz",
+    "telemetry_sinks",
+    "telemetry_snapshot_steps",
+    "mem_sample_steps",
+    "watchdog_deadline_seconds",
+    "log_every_epochs",
+    "log_every_steps",
+    "lint_on_start",
+    "checkpoint_every_epochs",
+    "checkpoint_steps",
+    "keep_best",
+)
+
+
+def quality_digest(config_snapshot: dict) -> str:
+    """Seed-invariant sibling of the run's ``config_digest``: the digest
+    of the config with :data:`QUALITY_DIGEST_EXCLUDED` keys dropped.
+
+    ``run_id`` (= ``config_digest`` of the full snapshot) folds ``seed``,
+    so every seed is a DIFFERENT registry series — useless for a seed
+    band. ``quality_digest`` names the learning recipe itself: N seeded
+    runs of one recipe share it, which is what ``tpu_ddp/curves`` keys
+    its baseline envelopes on (docs/curves.md)."""
+    return config_digest({
+        k: v for k, v in config_snapshot.items()
+        if k not in QUALITY_DIGEST_EXCLUDED
+    })
+
+
 def artifact_provenance(
     *,
     descriptor: Any = None,
     run_id: Optional[str] = None,
+    quality_digest: Optional[str] = None,
     device_kind: Optional[str] = None,
     jax_version: Optional[str] = None,
     strategy: Optional[str] = None,
@@ -115,6 +173,10 @@ def artifact_provenance(
     }
     if run_id:
         prov["run_id"] = run_id
+    if quality_digest:
+        # the seed-invariant series key, carried BESIDE run_id wherever
+        # the run stamped one (docs/curves.md)
+        prov["quality_digest"] = quality_digest
     if device_kind is not None:
         prov["device_kind"] = device_kind
     if jax_version is not None:

@@ -26,11 +26,21 @@ TRACE_SUMMARY_SCHEMA_VERSION = 1
 
 def find_trace_files(path: str) -> List[str]:
     """Resolve a summarize target: a trace file itself, or a run dir
-    holding ``trace-p*.jsonl`` (one per host)."""
+    holding ``trace-p*.jsonl`` (one per host; all incarnations, ordered
+    host-major then incarnation-ascending — lexical sorting would put
+    ``trace-p0.i1.jsonl`` BEFORE ``trace-p0.jsonl`` and break every
+    later-record-wins merge over the concatenated stream)."""
     if os.path.isfile(path):
         return [path]
     if os.path.isdir(path):
-        hits = sorted(glob.glob(os.path.join(path, "trace-p*.jsonl")))
+        from tpu_ddp.telemetry import parse_trace_name
+
+        def order(p: str):
+            parsed = parse_trace_name(os.path.basename(p))
+            return parsed[:2] if parsed else (1 << 30, 0)
+
+        hits = sorted(glob.glob(os.path.join(path, "trace-p*.jsonl")),
+                      key=lambda p: (order(p), p))
         if hits:
             return hits
         # tolerate a bare trace.jsonl (hand-rolled runs)
@@ -156,6 +166,68 @@ def run_label(records: Iterable[dict]) -> Optional[str]:
     return None
 
 
+def eval_points(records: Iterable[dict]) -> List[dict]:
+    """The run's eval HISTORY: every schema-versioned ``eval`` instant
+    the Trainer emitted (one per evaluation — docs/curves.md), merged
+    later-record-wins per anchor so a resumed run's replayed epochs
+    keep exactly one point each. Callers feeding several incarnations
+    must concatenate their records in incarnation order. Refuses points
+    from a future eval schema (the trace schema gate can't see nested
+    attrs)."""
+    from tpu_ddp.telemetry.events import EVAL_POINT_SCHEMA_VERSION
+
+    merged: Dict[tuple, dict] = {}
+    for rec in records:
+        if rec.get("type") != "instant" or rec.get("name") != "eval":
+            continue
+        attrs = rec.get("attrs") or {}
+        version = attrs.get("eval_schema_version")
+        if isinstance(version, int) and version > EVAL_POINT_SCHEMA_VERSION:
+            raise ValueError(
+                f"eval point schema_version {version} is newer than this "
+                f"tool understands ({EVAL_POINT_SCHEMA_VERSION})"
+            )
+        point = {
+            "step": rec.get("step"),
+            "epoch": attrs.get("epoch"),
+            "final": bool(attrs.get("final")),
+            "test_loss": attrs.get("test_loss"),
+            "test_accuracy": attrs.get("test_accuracy"),
+        }
+        key = (("final",) if point["final"]
+               else ("epoch", point["epoch"])
+               if point["epoch"] is not None
+               else ("step", point["step"]))
+        merged[key] = point
+    return sorted(
+        merged.values(),
+        key=lambda p: (p["step"] if isinstance(p["step"], int) else -1,
+                       p["final"]),
+    )
+
+
+def format_eval_series(points: List[dict]) -> List[str]:
+    """The eval-history block ``trace summarize`` renders — one line per
+    recorded eval point. Empty when the run never evaluated (no
+    --eval-each-epoch and no final eval)."""
+    if not points:
+        return []
+    lines = [f"eval history ({len(points)} point(s)):"]
+    for p in points:
+        anchor = ("final" if p["final"]
+                  else f"epoch {p['epoch']}" if p["epoch"] is not None
+                  else "?")
+        bits = [f"  {anchor:<9}"]
+        if p["step"] is not None:
+            bits.append(f"step {p['step']:<6}")
+        if isinstance(p["test_loss"], (int, float)):
+            bits.append(f"loss {p['test_loss']:.4f}")
+        if isinstance(p["test_accuracy"], (int, float)):
+            bits.append(f"acc {p['test_accuracy']:.4f}")
+        lines.append(" ".join(bits))
+    return lines
+
+
 def _human_bytes(n: float) -> str:
     for unit in ("B", "KB", "MB", "GB"):
         if abs(n) < 1024:
@@ -225,6 +297,10 @@ def summarize(path: str) -> str:
                 f"{1e3 * skew['median']:.2f}ms (host {skew['host']} at "
                 f"{1e3 * skew['value']:.2f}ms)"
             )
+    evals = format_eval_series(eval_points(records))
+    if evals:
+        lines.append("")
+        lines.extend(evals)
     snaps = last_counters(records)
     for pid in sorted(snaps):
         counters = snaps[pid]
@@ -295,6 +371,7 @@ def summarize_json(path: str) -> dict:
         "run_meta": meta or None,
         "provenance": artifact_provenance(
             run_id=meta.get("run_id"),
+            quality_digest=meta.get("quality_digest"),
             descriptor={"artifact": "trace_summary",
                         "strategy": meta.get("strategy"),
                         "mesh": meta.get("mesh")},
@@ -303,6 +380,7 @@ def summarize_json(path: str) -> dict:
             strategy=meta.get("strategy"),
             mesh=meta.get("mesh"),
         ),
+        "eval_points": eval_points(records),
         "phases": {
             name: {
                 "count": h.count,

@@ -14,10 +14,13 @@ Two gates, exits non-zero if either fails:
    ZeRO-1 parity tests pinned).
 2. **int8 loss-trajectory tolerance**: the same tiny synthetic config
    trained uncompressed vs ``--grad-compress int8`` (+ error feedback)
-   for ~20 steps; the per-epoch loss trajectories must stay within
+   for ~20 steps; the per-step loss trajectories must stay within
    ``--tolerance`` (wire quantization is the ONLY difference — a drift
    beyond tolerance means the compressed sync is no longer computing an
-   unbiased mean).
+   unbiased mean). The verdict comes from ``tpu-ddp curves diff`` over
+   the two runs' recorded health/trace curves — the demo and the
+   convergence observatory share ONE parity oracle (docs/curves.md)
+   instead of a hand-rolled drift check only this file trusted.
 
 CI runs this next to zero-demo/health-demo (.github/workflows/ci.yml).
 """
@@ -27,6 +30,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import os
+import shutil
 import sys
 
 
@@ -99,10 +103,12 @@ def _ring_parity_gate(n: int) -> bool:
     return ok
 
 
-def _trajectory_gate(n: int, steps: int, tolerance: float) -> bool:
-    """Gate 2: int8 (+EF) loss trajectory vs uncompressed."""
-    import numpy as np
-
+def _trajectory_gate(n: int, steps: int, tolerance: float,
+                     run_root: str) -> bool:
+    """Gate 2: int8 (+EF) loss trajectory vs uncompressed, judged by
+    the shared ``tpu-ddp curves diff`` oracle over the two runs'
+    recorded curves (per-step health loss + eval history)."""
+    from tpu_ddp.curves.report import main as curves_main
     from tpu_ddp.train.trainer import TrainConfig, Trainer
 
     per_shard = 16
@@ -112,28 +118,34 @@ def _trajectory_gate(n: int, steps: int, tolerance: float) -> bool:
         synthetic_data=True, synthetic_size=size, epochs=epochs,
         per_shard_batch=per_shard, n_devices=n, momentum=0.9, lr=1e-2,
         log_every_epochs=1, eval_each_epoch=True, seed=0, prefetch_depth=0,
+        health="on", telemetry_sinks="jsonl",
     )
     runs = {}
+    dirs = {}
     for name, kw in (
         ("uncompressed", {}),
         ("int8", dict(grad_compress="int8",
                       grad_compress_error_feedback=True)),
     ):
-        trainer = Trainer(dataclasses.replace(base, **kw).validate())
-        metrics = trainer.run()
+        run_dir = os.path.join(run_root, name)
+        shutil.rmtree(run_dir, ignore_errors=True)
+        dirs[name] = run_dir
+        trainer = Trainer(dataclasses.replace(
+            base, telemetry_dir=run_dir, **kw).validate())
+        metrics = trainer.run(close=False)
+        trainer.record_final_eval(accuracy=metrics.get("test_accuracy"))
+        trainer.close()
         runs[name] = trainer
         print(f"[compress-demo] {name}: losses="
               f"{[round(x, 6) for x in trainer.history['train_loss']]} "
               f"final_acc={metrics.get('test_accuracy')}", flush=True)
-    loss_a = np.asarray(runs["uncompressed"].history["train_loss"])
-    loss_b = np.asarray(runs["int8"].history["train_loss"])
-    drift = float(np.abs(loss_a - loss_b).max())
-    total = steps
-    print(f"[compress-demo] int8 loss drift over {total} steps: {drift:.6f}"
-          f" (tolerance {tolerance})", flush=True)
-    if drift > tolerance:
-        print(f"[compress-demo] FAIL: int8 trajectory diverged: "
-              f"{loss_a} vs {loss_b}", flush=True)
+    # the shared oracle: same verdict `tpu-ddp curves diff` gives any
+    # overlay-parity question — exit 0 within tolerance, 1 on drift
+    rc = curves_main(["diff", dirs["uncompressed"], dirs["int8"],
+                      "--tolerance", str(tolerance)])
+    if rc != 0:
+        print(f"[compress-demo] FAIL: `tpu-ddp curves diff` exit {rc}: "
+              "int8 trajectory diverged beyond tolerance", flush=True)
         return False
     acct = runs["int8"]._compress.accounting()
     print(f"[compress-demo] wire bytes/step/device: "
@@ -150,7 +162,11 @@ def main(argv=None) -> int:
     p.add_argument("--steps", type=int, default=20,
                    help="optimizer steps for the trajectory gate")
     p.add_argument("--tolerance", type=float, default=0.05,
-                   help="max per-epoch |loss(int8) - loss(f32)|")
+                   help="max per-step |loss(int8) - loss(f32)| "
+                        "(the `tpu-ddp curves diff` gate)")
+    p.add_argument("--dir", default="/tmp/tpu_ddp_compress_demo",
+                   help="scratch dir for the two runs' telemetry "
+                        "(the curves-diff evidence)")
     args = p.parse_args(argv)
     _force_cpu(args.devices)
 
@@ -159,7 +175,8 @@ def main(argv=None) -> int:
     jax.config.update("jax_platforms", "cpu")
 
     ok = _ring_parity_gate(args.devices)
-    ok = _trajectory_gate(args.devices, args.steps, args.tolerance) and ok
+    ok = _trajectory_gate(args.devices, args.steps, args.tolerance,
+                          args.dir) and ok
     print(f"[compress-demo] {'PASS' if ok else 'FAIL'}", flush=True)
     return 0 if ok else 1
 

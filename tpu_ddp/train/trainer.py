@@ -529,6 +529,7 @@ class Trainer:
             config_digest,
             git_provenance,
             next_incarnation,
+            quality_digest,
         )
 
         # run_id: a short stable config digest — deterministic, so every
@@ -551,6 +552,11 @@ class Trainer:
         self.run_meta = {
             "run_meta_schema_version": RUN_META_SCHEMA_VERSION,
             "run_id": run_id,
+            # the seed-invariant sibling of run_id: N seeded runs of one
+            # learning recipe share it, so the convergence observatory
+            # (docs/curves.md) can build seed-band baselines across runs
+            # whose run_ids all differ
+            "quality_digest": quality_digest(config_snapshot),
             "incarnation": self.incarnation,
             "config": config_snapshot,
             "jax_version": jax.__version__,
@@ -1380,6 +1386,18 @@ class Trainer:
             tel.gauge("eval/final_test_loss").set(loss)
         if self._best_acc != float("-inf"):
             tel.gauge("eval/best_test_accuracy").set(self._best_acc)
+        # the final eval point, anchored like the per-epoch ones so the
+        # trace carries the whole eval history (docs/curves.md)
+        from tpu_ddp.telemetry import EVAL_POINT_SCHEMA_VERSION
+
+        tel.instant(
+            "eval", step=int(self.state.step),
+            eval_schema_version=EVAL_POINT_SCHEMA_VERSION,
+            final=True,
+            **({"test_loss": loss} if loss is not None else {}),
+            **({"test_accuracy": accuracy} if accuracy is not None
+               else {}),
+        )
 
     def lint_preflight(self, *, raise_on_error: bool = True):
         """Run the static graph lint (``tpu_ddp/analysis/lint.py``) over
@@ -1917,6 +1935,21 @@ class Trainer:
                     tel.gauge("eval/test_loss").set(loss)
                     if c.loss == "ce":
                         tel.gauge("eval/test_accuracy").set(acc)
+                    # ... and the durable HISTORY the gauges can't keep:
+                    # one step/epoch-anchored eval instant per evaluation
+                    # (incarnation-safe — the sink file is stamped — and
+                    # replay-safe: readers key on epoch, later life wins).
+                    # The convergence observatory reads these back
+                    # (docs/curves.md)
+                    from tpu_ddp.telemetry import EVAL_POINT_SCHEMA_VERSION
+
+                    tel.instant(
+                        "eval", step=int(self.state.step),
+                        eval_schema_version=EVAL_POINT_SCHEMA_VERSION,
+                        epoch=epoch, test_loss=loss,
+                        **({"test_accuracy": acc} if c.loss == "ce"
+                           else {}),
+                    )
                 if c.loss == "ce":  # accuracy undefined for multi-hot targets
                     self.logger.log(
                         int(self.state.step), test_accuracy=acc, test_loss=loss
