@@ -6,7 +6,7 @@ PYTHON ?= python
 .PHONY: test test-all dryrun bench smoke capture aot real-data lint \
 	trace-demo health-demo zero-demo compress-demo analyze-demo \
 	lint-demo monitor-demo profile-demo goodput-demo registry-demo \
-	tune-demo mem-demo curves-demo bench-compare
+	tune-demo mem-demo curves-demo chaos-demo bench-compare
 
 # Fast default loop (round-3 verdict item 5): skips the `slow`-marked
 # multi-process / end-to-end-CLI / AOT tests. CI and pre-commit should run
@@ -243,6 +243,25 @@ curves-demo:
 	rm -rf $(CURVES_DEMO_DIR)
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
 	  $(PYTHON) -m tpu_ddp.tools.curves_demo --dir $(CURVES_DEMO_DIR)
+
+# Elastic-runtime acceptance (docs/resilience.md): a supervised
+# (`tpu-ddp elastic train`) run on the 8-virtual-device CPU mesh with
+# three injected faults — save-io-flake x2 at the step-3 checkpoint
+# (retried with backoff), checkpoint-corrupt of the newest save (step
+# 6, bit-flipped after its checksum manifest lands), kill-host at step
+# 8 with 4 survivors — must recover WITHOUT human input: classify
+# `killed`, re-mesh 8->4 at the same global batch, REFUSE the corrupt
+# step by name, resume from the older verified step, finish clean. The
+# goodput ledger must show exactly 2 incarnations with 5 replayed
+# steps, categories summing to elapsed within 2%, and the elastic
+# decision join; `tpu-ddp curves --against` a 3-seed band recorded on
+# 4 devices must pass the recovered run (the band is mesh-invariant by
+# construction). Exits nonzero on any miss (tpu_ddp/tools/chaos_demo.py).
+CHAOS_DEMO_DIR ?= /tmp/tpu_ddp_chaos_demo
+chaos-demo:
+	rm -rf $(CHAOS_DEMO_DIR)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  $(PYTHON) -m tpu_ddp.tools.chaos_demo --dir $(CHAOS_DEMO_DIR)
 
 # Deviceless perf-regression gate: re-capture the AOT artifact with the
 # real XLA:TPU toolchain (needs libtpu; ~30+ min of compiles) and diff

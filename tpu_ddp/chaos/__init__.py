@@ -1,0 +1,26 @@
+"""Deterministic fault injection (``--chaos spec.json``).
+
+The elastic runtime's whole value — re-mesh restarts, verified-
+checkpoint recovery, backoff policy — is only trustworthy if it is
+*exercised*, and real faults (host loss, bit rot, flaky blob stores)
+don't show up on demand in CI. This package makes them show up on
+demand: step-triggered, host-targeted, seeded faults injected into a
+live training run, replayable bit-for-bit on the 4/8-virtual-device CPU
+mesh (docs/resilience.md has the spec schema and the fault catalog).
+"""
+
+from tpu_ddp.chaos.inject import (
+    CHAOS_SCHEMA_VERSION,
+    FAULT_KINDS,
+    KILL_EXIT_CODE,
+    ChaosInjector,
+    load_spec,
+)
+
+__all__ = [
+    "CHAOS_SCHEMA_VERSION",
+    "FAULT_KINDS",
+    "KILL_EXIT_CODE",
+    "ChaosInjector",
+    "load_spec",
+]

@@ -9,9 +9,25 @@ Reference behavior covered and exceeded:
     every-rank-writes-one-path race at ``main.py:45``;
   * resume: the capability the runnable reference lacks entirely;
   * partial restore + head swap: the ``strict=False`` fine-tuning load of
-    ``ppe_main_ddp.py:104-111``, as shape-tolerant param merging.
+    ``ppe_main_ddp.py:104-111``, as shape-tolerant param merging;
+  * verified saves: SHA-256 checksum manifests written at save and
+    checked at restore, so a torn/bit-flipped checkpoint is a NAMED
+    refusal with fallback to the next-older verified step, and transient
+    save IO failures retry with bounded backoff (docs/resilience.md).
 """
 
-from tpu_ddp.checkpoint.manager import Checkpointer, merge_params
+from tpu_ddp.checkpoint import manifest
 
-__all__ = ["Checkpointer", "merge_params"]
+__all__ = ["Checkpointer", "manifest", "merge_params"]
+
+
+def __getattr__(name):
+    # Lazy (PEP 562): the manager pulls in orbax + jax, but the checksum
+    # manifests must stay importable from stdlib-only readers — the
+    # elastic supervisor and `tpu-ddp goodput` verify checkpoints on
+    # boxes (and in processes) that must never initialize a backend.
+    if name in ("Checkpointer", "merge_params"):
+        from tpu_ddp.checkpoint import manager
+
+        return getattr(manager, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,30 +1,169 @@
-"""Orbax checkpoint manager + shape-tolerant restore."""
+"""Orbax checkpoint manager + shape-tolerant restore + verified saves."""
 
 from __future__ import annotations
 
 import json
 import logging
 import os
+import random
+import threading
 import time
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
+from tpu_ddp.checkpoint import manifest as ckpt_manifest
+
 log = logging.getLogger(__name__)
 
 
+class _ManifestWriter:
+    """Background checksum-manifest writer for ASYNC saves.
+
+    Orbax exposes no public finalize hook, so manifest writing cannot
+    ride the save's own completion path: this daemon thread polls for
+    the step dir's atomic commit rename and hashes it the moment it
+    lands — otherwise a kill between an async save and the next wait
+    barrier would leave the newest checkpoint permanently unverifiable.
+    Synchronous saves (``wait=True`` / ``save_as_only``) write their
+    manifest inline and never pass through here."""
+
+    def __init__(self, directory: str, telemetry):
+        self.directory = directory
+        self.telemetry = telemetry
+        self._pending: list = []           # steps awaiting commit
+        self._lock = threading.Lock()      # pending list + write section
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, step: int) -> None:
+        with self._lock:
+            if int(step) not in (s for s, _ in self._pending):
+                self._pending.append((int(step), time.monotonic()))
+        if self._thread is None:
+            # lazy: a Checkpointer that only ever saves synchronously
+            # (or never saves) costs no thread
+            self._thread = threading.Thread(
+                target=self._run, name="tpu-ddp-ckpt-manifest",
+                daemon=True,
+            )
+            self._thread.start()
+        self._wake.set()
+
+    #: a submitted step whose commit never lands (background orbax IO
+    #: failure) is abandoned after this long, so the writer does not
+    #: poll forever and flush() does not burn its timeout at every
+    #: subsequent save barrier
+    ABANDON_AFTER_S = 120.0
+
+    def _write_ready(self) -> bool:
+        """Manifest every pending step whose commit has landed; returns
+        True when nothing is left pending. Hashing runs OUTSIDE the
+        lock: submit() is called from the training loop, and a multi-GB
+        checkpoint's SHA-256 pass must never stall a step behind it
+        (manifest writes are atomic replaces, so a rare double-write
+        from a concurrent flush() is harmless)."""
+        with self._lock:
+            pending = list(self._pending)
+        done = []
+        for step, submitted in pending:
+            if not os.path.isdir(os.path.join(self.directory, str(step))):
+                if time.monotonic() - submitted > self.ABANDON_AFTER_S:
+                    log.warning(
+                        "checkpoint step %d never committed within "
+                        "%.0fs of its save initiation; abandoning its "
+                        "manifest (the save itself likely failed)",
+                        step, self.ABANDON_AFTER_S)
+                    done.append(step)
+                continue
+            try:
+                ckpt_manifest.write_manifest(self.directory, step)
+                if self.telemetry is not None:
+                    self.telemetry.count("checkpoint/manifests")
+            except OSError as e:
+                log.warning(
+                    "checksum manifest for step %d failed: %s "
+                    "(the step stays restorable but unverifiable)",
+                    step, e)
+            done.append(step)
+        if done:
+            # retention may have deleted older steps by now
+            ckpt_manifest.sweep_manifests(
+                self.directory,
+                ckpt_manifest.committed_steps(self.directory))
+        with self._lock:
+            if done:
+                self._pending = [(s, t) for s, t in self._pending
+                                 if s not in done]
+            return not self._pending
+
+    def flush(self, timeout: float = 30.0) -> None:
+        """Block until every submitted step is manifested (call under a
+        save barrier, where every pending step has committed)."""
+        deadline = time.monotonic() + timeout
+        while not self._write_ready():
+            if time.monotonic() > deadline:
+                log.warning(
+                    "manifest flush timed out with steps still pending")
+                return
+            time.sleep(0.02)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=0.2)
+            self._wake.clear()
+            while not self._stop.is_set():
+                if self._write_ready():
+                    break
+                time.sleep(0.05)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._write_ready()
+
+
 class Checkpointer:
-    """Step-keyed checkpoints of the full TrainState."""
+    """Step-keyed checkpoints of the full TrainState.
+
+    Beyond the orbax wrapper: every committed save gets a SHA-256
+    checksum manifest (``manifests/step-<N>.json``, written by a
+    background writer for async saves), restore verifies the manifest
+    and *refuses a corrupt step by name* — falling back to the next-
+    older verified step — and transient save IO failures retry with
+    bounded exponential backoff + jitter (docs/resilience.md).
+
+    ``fault_hook(step, attempt)`` is the chaos harness's injection seam
+    (``chaos/inject.py`` raises ``OSError`` from it to exercise the
+    retry path deterministically); it runs before each save attempt.
+    """
 
     # intent record for save_as_only's delete sweep (see _sweep_stale)
     _ONLY_MARKER = "only_step.json"
 
-    def __init__(self, directory: str, max_to_keep: int = 3, telemetry=None):
+    def __init__(self, directory: str, max_to_keep: int = 3, telemetry=None,
+                 *, save_attempts: int = 3, save_retry_base_s: float = 0.25,
+                 save_retry_cap_s: float = 5.0,
+                 fault_hook: Optional[Callable[[int, int], None]] = None,
+                 write_manifests: bool = True,
+                 verify_on_restore: bool = True):
+        if save_attempts < 1:
+            raise ValueError(
+                f"save_attempts must be >= 1, got {save_attempts}")
         self.directory = os.path.abspath(directory)
         if telemetry is None:
             from tpu_ddp.telemetry import NULL as telemetry
         self.telemetry = telemetry
+        self.save_attempts = save_attempts
+        self.save_retry_base_s = save_retry_base_s
+        self.save_retry_cap_s = save_retry_cap_s
+        self.fault_hook = fault_hook
+        self.verify_on_restore = verify_on_restore
         # async saves whose completion has not yet been OBSERVED:
         # [(step, initiation monotonic time)] — drained by
         # wait_until_finished into the completion-side telemetry
@@ -34,6 +173,13 @@ class Checkpointer:
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True
             ),
+        )
+        # one manifest per checkpoint, one writer per run: process 0
+        # owns the files (same convention as the save_as_only marker)
+        self._manifests = write_manifests and jax.process_index() == 0
+        self._manifest_writer = (
+            _ManifestWriter(self.directory, telemetry)
+            if self._manifests else None
         )
 
     def _marker_step(self) -> Optional[int]:
@@ -77,17 +223,91 @@ class Checkpointer:
         # wait_until_finished (checkpoint/io_seconds), so async saves are
         # visible in traces instead of silently free.
         t0 = time.monotonic()
-        with self.telemetry.span("checkpoint", step=step, wait=wait):
-            self.manager.save(step, args=ocp.args.StandardSave(state))
+        try:
+            retries = self._save_with_retry(step, state, wait=wait)
+        except OSError as e:
+            # bounded attempts exhausted: record the loss loudly — the
+            # cadence save is gone, but training must not die for it.
+            # The instant is the goodput ledger's evidence (stitch.py
+            # notes it), so a run that later dies past this point shows
+            # WHY its replay window is wider than the cadence promised.
+            # A final save (wait=True) re-raises: exiting "clean" while
+            # silently dropping the terminal checkpoint would be a lie.
+            self.telemetry.count("checkpoint/save_failures")
+            self.telemetry.instant(
+                "checkpoint_save_failed", step=step,
+                attempts=self.save_attempts, error=str(e)[:300])
+            log.error(
+                "checkpoint save at step %d FAILED after %d attempts: %s",
+                step, self.save_attempts, e)
             if wait:
-                self.manager.wait_until_finished()
+                raise
+            return
         if wait:
             # the barrier drained every older in-flight save too
             finished, self._pending = self._pending, []
             self._observe_completion(finished + [(step, t0)])
+            self._manifest_now(step)
         else:
             self._pending.append((step, t0))
+            if self._manifest_writer is not None:
+                self._manifest_writer.submit(step)
+        if retries:
+            self.telemetry.instant(
+                "checkpoint_save_retried", step=step, retries=retries)
         self.telemetry.count("checkpoint/saves")
+
+    def _save_with_retry(self, step: int, state: Any, *, wait: bool) -> int:
+        """One logical save as bounded attempts with exponential backoff
+        + jitter; returns the number of retries spent. Each attempt runs
+        inside its own ``checkpoint`` span carrying ``retries=<attempt>``
+        (a failed attempt's time is real checkpoint-save badput and is
+        accounted as such). Raises the last ``OSError`` when the attempt
+        budget is exhausted."""
+        attempt = 0
+        while True:
+            try:
+                with self.telemetry.span(
+                    "checkpoint", step=step, wait=wait, retries=attempt
+                ):
+                    if self.fault_hook is not None:
+                        self.fault_hook(step, attempt)
+                    self.manager.save(
+                        step, args=ocp.args.StandardSave(state))
+                    if wait:
+                        self.manager.wait_until_finished()
+                return attempt
+            except OSError as e:
+                attempt += 1
+                if attempt >= self.save_attempts:
+                    raise
+                delay = min(
+                    self.save_retry_base_s * (2 ** (attempt - 1)),
+                    self.save_retry_cap_s,
+                )
+                delay *= 1.0 + random.uniform(0.0, 0.25)
+                log.warning(
+                    "checkpoint save at step %d: attempt %d/%d failed "
+                    "(%s); retrying in %.2fs",
+                    step, attempt, self.save_attempts, e, delay)
+                self.telemetry.count("checkpoint/save_retries")
+                time.sleep(delay)
+
+    def _manifest_now(self, step: int) -> None:
+        """Inline manifest for a save known to be committed (we are under
+        its barrier): no writer-thread latency window."""
+        if not self._manifests:
+            return
+        try:
+            ckpt_manifest.write_manifest(self.directory, step)
+            self.telemetry.count("checkpoint/manifests")
+            ckpt_manifest.sweep_manifests(
+                self.directory,
+                ckpt_manifest.committed_steps(self.directory))
+        except OSError as e:
+            log.warning(
+                "checksum manifest for step %d failed: %s (the step "
+                "stays restorable but unverifiable)", step, e)
 
     def _observe_completion(self, finished) -> None:
         """Completion-side accounting for saves whose IO has landed:
@@ -112,6 +332,11 @@ class Checkpointer:
             self.manager.wait_until_finished()
         finished, self._pending = self._pending, []
         self._observe_completion(finished)
+        if self._manifest_writer is not None and finished:
+            # under the barrier every submitted step has committed:
+            # drain the writer so the manifests exist before the caller
+            # (e.g. a drain path about to exit) moves on
+            self._manifest_writer.flush()
 
     def save_as_only(self, step: int, state: Any) -> None:
         """Replace whatever checkpoints exist with this one. The best-
@@ -163,6 +388,7 @@ class Checkpointer:
             if s != step:
                 self.manager.delete(s)
         self._clear_marker()
+        self._manifest_now(step)
 
     def latest_step(self) -> Optional[int]:
         """Newest meaningful step: a pending save_as_only intent marker
@@ -172,8 +398,45 @@ class Checkpointer:
         marked = self._marker_step()
         return marked if marked is not None else self.manager.latest_step()
 
+    def verified_restore_step(self) -> Optional[int]:
+        """The step restore() would pick with no explicit step: newest
+        VERIFIED checkpoint — a step whose checksum manifest fails is
+        refused by name (``checkpoint_refused`` instant +
+        ``checkpoint/verify_refused`` counter) and the next-older
+        verified step wins; an unmanifested (legacy) step is accepted
+        with a note. The save_as_only intent marker still overrides the
+        newest-step rule (its step is the only candidate)."""
+        marked = self._marker_step()
+        candidates = [marked] if marked is not None else [
+            int(s) for s in self.manager.all_steps()
+        ]
+        if not self.verify_on_restore:
+            return max(candidates) if candidates else None
+        step, refusals = ckpt_manifest.latest_verified_step(
+            self.directory, candidates=candidates)
+        for refusal in refusals:
+            if refusal["verdict"] != "refused":
+                continue
+            self.telemetry.count("checkpoint/verify_refused")
+            self.telemetry.instant(
+                "checkpoint_refused", step=refusal["step"],
+                problems=refusal["problems"][:8])
+        if step is not None and refusals:
+            fell_back = any(r["verdict"] == "refused" for r in refusals)
+            if fell_back:
+                log.warning(
+                    "falling back to checkpoint step %d (next-older "
+                    "verified step)", step)
+        return step
+
     def restore(self, state_template: Any, step: Optional[int] = None) -> Any:
         """Restore into the structure/shardings of `state_template`.
+
+        With no explicit ``step`` the newest VERIFIED checkpoint is
+        restored (``verified_restore_step``); an explicit step that
+        fails its manifest raises ``ValueError`` naming the mismatched
+        files — an explicitly requested checkpoint has no fallback to
+        fall to, so it must refuse loudly rather than load garbage.
 
         Restore is synchronous (training cannot start without the state),
         so unlike the async save path one span + one counter pair tells
@@ -182,9 +445,25 @@ class Checkpointer:
         — the restore-cost input of the goodput ledger's
         ``checkpoint_restore`` badput category and of the Young–Daly
         checkpoint-interval advisor (docs/goodput.md)."""
-        step = self.latest_step() if step is None else step
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+            step = self.verified_restore_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no restorable checkpoint under {self.directory} "
+                    "(none exist, or every existing step failed its "
+                    "checksum manifest — see the checkpoint_refused "
+                    "telemetry instants)")
+        elif self.verify_on_restore:
+            verdict, problems = ckpt_manifest.verify_step(
+                self.directory, step)
+            if verdict is False:
+                self.telemetry.count("checkpoint/verify_refused")
+                self.telemetry.instant(
+                    "checkpoint_refused", step=step,
+                    problems=problems[:8])
+                raise ValueError(
+                    f"checkpoint step {step} REFUSED by its checksum "
+                    f"manifest: {'; '.join(problems)}")
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_template)
         t0 = time.monotonic()
         with self.telemetry.span("checkpoint_restore", step=step):
@@ -198,6 +477,8 @@ class Checkpointer:
 
     def close(self) -> None:
         self.wait_until_finished()
+        if self._manifest_writer is not None:
+            self._manifest_writer.stop()
         self.manager.close()
 
 

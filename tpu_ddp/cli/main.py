@@ -4,6 +4,15 @@ Subcommands:
 
 - ``tpu-ddp train ...``   — the training CLI (same flags as tpu-ddp-train)
 - ``tpu-ddp launch ...``  — the multi-process launcher (tpu-ddp-launch)
+- ``tpu-ddp elastic train ...`` — supervised elastic training: wraps
+  the train CLI in a restart loop that classifies each death via the
+  goodput ledger's exit taxonomy, applies per-failure-class bounded-
+  backoff budgets, re-meshes to the surviving device set (named
+  refusals; ``--fallback-plan tune.json`` re-plans through the
+  auto-tuner's next-ranked candidate), resumes from the newest
+  checksum-VERIFIED checkpoint, and logs every decision to
+  ``elastic.jsonl`` — which ``tpu-ddp goodput`` joins
+  (docs/resilience.md).
 - ``tpu-ddp trace summarize <run_dir>`` — aggregate a telemetry JSONL
   trace into per-phase percentiles (p50/p95/max) and the final
   counters/gauges snapshot.
@@ -126,6 +135,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_ddp.cli.launch import main as launch_main
 
         return launch_main(argv[1:])
+    # elastic is stdlib-only: the supervisor must not import jax (it
+    # outlives the runtime it supervises); the child process it execs
+    # is where jax lives
+    if argv[:1] == ["elastic"]:
+        from tpu_ddp.elastic.supervisor import main as elastic_main
+
+        return elastic_main(argv[1:])
     # analyze / bench own their argparse surfaces (like train/launch):
     # hand the remainder through so their --help shows the full surface
     if argv[:1] == ["analyze"]:
@@ -189,6 +205,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     sub.add_parser("train", help="run the trainer (tpu-ddp train --help)")
     sub.add_parser("launch", help="multi-process launcher "
                                   "(tpu-ddp launch --help)")
+    sub.add_parser(
+        "elastic",
+        help="supervised elastic training: restart loop with failure-"
+             "class budgets, re-mesh to survivors, verified-checkpoint "
+             "recovery, elastic.jsonl decision log "
+             "(tpu-ddp elastic --help)",
+    )
     trace = sub.add_parser("trace", help="telemetry trace tools")
     trace_sub = trace.add_subparsers(dest="trace_command", required=True)
     summ = trace_sub.add_parser(

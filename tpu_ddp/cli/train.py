@@ -256,6 +256,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "and dumps all thread stacks when no step "
                         "completes within the deadline (multihost wedge "
                         "forensics)")
+    p.add_argument("--watchdog-abort", action="store_true",
+                   help="escalate a watchdog firing: after the stack "
+                        "dump, exit the wedged process with the `hang` "
+                        "class so a supervisor (`tpu-ddp elastic`) can "
+                        "restart it — without this the dump is forensics "
+                        "only and the wedge burns chips forever "
+                        "(docs/resilience.md)")
+    p.add_argument("--chaos", default=None, metavar="SPEC.JSON",
+                   help="deterministic fault injection: step-triggered "
+                        "kill-host / hang / checkpoint-corrupt / "
+                        "save-io-flake / data-stall faults on configured "
+                        "hosts, seeded and fire-once per logical run "
+                        "(state in --telemetry-dir) — the elastic "
+                        "runtime's CI harness (docs/resilience.md)")
     p.add_argument("--health", choices=["off", "on"], default="off",
                    help="numerics flight recorder: global grad/param/"
                         "update norms + NaN/Inf sentinels computed INSIDE "
@@ -459,6 +473,8 @@ def config_from_args(args) -> TrainConfig:
         monitor_bind=args.monitor_bind,
         monitor_allow_remote_trigger=args.monitor_allow_remote_trigger,
         watchdog_deadline_seconds=args.watchdog_deadline,
+        watchdog_abort=args.watchdog_abort,
+        chaos_spec=args.chaos,
         health=args.health,
         health_policy=args.health_policy,
         health_per_layer_stride=args.health_per_layer_stride,

@@ -26,9 +26,24 @@ from tpu_ddp.ledger.taxonomy import CATEGORIES, RunLedger, build_ledger
 LEDGER_SCHEMA_VERSION = 1
 
 
-def ledger_json(ledger: RunLedger) -> dict:
+def elastic_decisions(run_dir: str) -> List[dict]:
+    """The elastic supervisor's decision log for this run dir (empty
+    when the run was not supervised) — the join that attributes each
+    ``restart_gap`` second to a *decision* (fault class -> action ->
+    backoff -> new mesh -> resume step) instead of merely observing it
+    (docs/resilience.md)."""
+    from tpu_ddp.elastic.recovery import read_decisions
+
+    return read_decisions(run_dir)
+
+
+def ledger_json(ledger: RunLedger,
+                decisions: Optional[List[dict]] = None) -> dict:
     """The ``--json`` artifact: ``{"schema_version", "ledger": {...}}``
     (``bench compare``'s ``load_artifact`` understands this shape)."""
+    if decisions is None:
+        decisions = elastic_decisions(ledger.run_dir)
+    extra = {"elastic": {"decisions": decisions}} if decisions else {}
     return {
         "schema_version": LEDGER_SCHEMA_VERSION,
         "type": "goodput_ledger",
@@ -63,6 +78,7 @@ def ledger_json(ledger: RunLedger) -> dict:
             },
             "recommendation": ledger.recommendation,
             "notes": list(ledger.notes),
+            **extra,
         },
     }
 
@@ -75,7 +91,50 @@ def _fmt_s(v: Optional[float]) -> str:
     return f"{v:.1f}s"
 
 
-def render_ledger(ledger: RunLedger) -> str:
+def _render_decision(record: dict) -> str:
+    event = record.get("event")
+    inc = record.get("incarnation")
+    if event == "launch":
+        plan = record.get("plan") or {}
+        devices = plan.get("n_devices") or "all"
+        return f"launch incarnation {inc}: {devices} device(s)"
+    if event == "exit":
+        return (f"incarnation {inc} exited "
+                f"{record.get('exit_class')}: supervision complete")
+    if event == "stop":
+        return (f"STOP after incarnation {inc} "
+                f"({record.get('exit_class', '-')}): "
+                f"{record.get('reason')}")
+    if event == "restart":
+        plan = record.get("plan") or {}
+        recovery = record.get("recovery") or {}
+        mesh = plan.get("mesh")
+        mesh_text = (
+            " mesh " + ",".join(f"{k}={v}" for k, v in mesh.items())
+            if mesh else "")
+        parts = [
+            f"restart -> incarnation {inc}: after "
+            f"{record.get('exit_class')!r} "
+            f"(attempt {record.get('attempt')}), backoff "
+            f"{record.get('backoff_s', 0):.2f}s, re-mesh -> "
+            f"{plan.get('n_devices') or 'all'} device(s)"
+            f"{mesh_text}, resume step {recovery.get('resume_step')}"
+        ]
+        if plan.get("candidate_name"):
+            parts.append(
+                f"fallback candidate {plan['candidate_name']!r}")
+        if record.get("remesh_refusal"):
+            parts.append(f"shrink refused: {record['remesh_refusal']}")
+        for refusal in recovery.get("refused") or []:
+            parts.append(
+                f"checkpoint step {refusal.get('step')} refused by "
+                "manifest")
+        return "; ".join(parts)
+    return f"{event}: {json.dumps(record, sort_keys=True)[:120]}"
+
+
+def render_ledger(ledger: RunLedger,
+                  decisions: Optional[List[dict]] = None) -> str:
     lines: List[str] = []
     label = [f"goodput: {ledger.run_dir}"]
     if ledger.run_id:
@@ -158,6 +217,14 @@ def render_ledger(ledger: RunLedger) -> str:
         lines.append(
             f"checkpoint advisor: no recommendation ({missing} — both "
             "a measured save cost and a measured MTBF are required)")
+    if decisions is None:
+        decisions = elastic_decisions(ledger.run_dir)
+    if decisions:
+        lines.append("")
+        lines.append("elastic decisions (elastic.jsonl — every "
+                     "restart_gap above is one of these):")
+        for record in decisions:
+            lines.append(f"  {_render_decision(record)}")
     for note in ledger.notes:
         lines.append(f"note: {note}")
     return "\n".join(lines)

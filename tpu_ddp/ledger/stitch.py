@@ -191,6 +191,18 @@ def load_incarnation(index: int, files: Dict[int, str]) -> IncarnationRecord:
                 saw_run_end = True
             elif name == "watchdog_hang":
                 saw_hang = True
+            elif name == "checkpoint_save_failed":
+                # a cadence save lost past its retry budget: the run
+                # kept going, but its replay window is now wider than
+                # the cadence promised — say so where the replay cost
+                # is accounted
+                attrs = r.get("attrs") or {}
+                rec.notes.append(
+                    f"incarnation {index}: checkpoint save at step "
+                    f"{r.get('step')} FAILED after "
+                    f"{attrs.get('attempts', '?')} attempts "
+                    f"({str(attrs.get('error', ''))[:80]}) — the replay "
+                    "window behind this life is wider than the cadence")
             else:
                 for instant, klass in _EXIT_INSTANTS:
                     if name == instant:

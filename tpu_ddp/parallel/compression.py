@@ -293,6 +293,42 @@ class GradCompressor:
         sh = NamedSharding(mesh, P(self.axis))
         return jax.tree.map(lambda _: sh, self.residual_template())
 
+    def deshard_residual(self, residual):
+        """State-layout residual -> PARAM-layout tree: the device-count-
+        independent form checkpoints persist (docs/resilience.md).
+
+        The per-device rows are summed first: what error feedback
+        carries is the TOTAL un-applied quantization error (each device
+        adds its own row into its local grads before the ring sums
+        them, so the ring folds in exactly the row-sum). The sum — not
+        the rows — is the layout-independent quantity, which is what
+        lets an 8-device run's residual resume on 4 devices without
+        losing carried error."""
+        return self.unflatten(jax.tree.map(
+            lambda r: jnp.sum(r.astype(jnp.float32), axis=0), residual))
+
+    def shard_residual(self, param_tree, mesh: Mesh):
+        """PARAM-layout residual -> this run's state layout
+        (``(n_shards, padded)`` rows, ``P(axis)``): the whole carried
+        error lands on row 0 and the other rows start at zero — row 0's
+        device folds it back on the next sync, so the total error the
+        de-shard summed is conserved bit-for-bit across a device-count
+        change (re-splitting it across rows would change nothing
+        mathematically and cost a reshard broadcast)."""
+        flat = self.flatten(jax.tree.map(
+            lambda x: jnp.asarray(x, jnp.float32), param_tree))
+        shardings = self.residual_shardings(mesh)
+        with mesh:
+            return jax.jit(
+                lambda t: jax.tree.map(
+                    lambda x: jnp.concatenate(
+                        [x[None],
+                         jnp.zeros((self.n_shards - 1,) + x.shape,
+                                   jnp.float32)]),
+                    t),
+                out_shardings=shardings,
+            )(flat)
+
     def init_residual(self, mesh: Mesh):
         """Fresh all-zero residual laid out ``P(axis)`` on the mesh."""
         shardings = self.residual_shardings(mesh)

@@ -46,7 +46,7 @@ from tpu_ddp.telemetry.sinks import (
     Sink,
     TerminalSummarySink,
 )
-from tpu_ddp.telemetry.watchdog import HangWatchdog
+from tpu_ddp.telemetry.watchdog import HANG_EXIT_CODE, HangWatchdog
 
 #: Default sink set when a run dir is given but no sink list.
 DEFAULT_SINKS = "jsonl,chrome,summary"
@@ -212,6 +212,7 @@ __all__ = [
     "JsonlTraceSink",
     "ChromeTraceSink",
     "TerminalSummarySink",
+    "HANG_EXIT_CODE",
     "HangWatchdog",
     "DEFAULT_SINKS",
     "build_telemetry",

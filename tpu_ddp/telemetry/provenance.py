@@ -127,10 +127,26 @@ QUALITY_DIGEST_EXCLUDED = (
     "checkpoint_every_epochs",
     "checkpoint_steps",
     "keep_best",
+    # fault wiring: injected faults / watchdog escalation change what a
+    # run SURVIVES, not what it learns (docs/resilience.md)
+    "chaos_spec",
+    "watchdog_abort",
 )
 
+#: keys that name the physical LAYOUT of a run, not its learning recipe
+#: — dropped from :func:`quality_digest` when the caller supplies the
+#: data-axis size, because the recipe-relevant quantity they encode is
+#: the GLOBAL batch (folded in as a derived key instead). This is what
+#: makes the seed band *mesh-invariant by construction*: an elastic
+#: re-mesh (8 devices -> 4 survivors at the same global batch) stays in
+#: the same band series, so `tpu-ddp curves --against` can be the final
+#: arbiter that a recovered run still learned (docs/resilience.md,
+#: docs/curves.md).
+QUALITY_DIGEST_LAYOUT_KEYS = ("n_devices", "mesh", "per_shard_batch")
 
-def quality_digest(config_snapshot: dict) -> str:
+
+def quality_digest(config_snapshot: dict,
+                   data_size: Optional[int] = None) -> str:
     """Seed-invariant sibling of the run's ``config_digest``: the digest
     of the config with :data:`QUALITY_DIGEST_EXCLUDED` keys dropped.
 
@@ -138,11 +154,26 @@ def quality_digest(config_snapshot: dict) -> str:
     so every seed is a DIFFERENT registry series — useless for a seed
     band. ``quality_digest`` names the learning recipe itself: N seeded
     runs of one recipe share it, which is what ``tpu_ddp/curves`` keys
-    its baseline envelopes on (docs/curves.md)."""
-    return config_digest({
+    its baseline envelopes on (docs/curves.md).
+
+    With ``data_size`` (the mesh's data-axis size — the Trainer always
+    passes it) the digest is additionally MESH-invariant: the layout
+    keys are replaced by the derived ``global_batch`` they determine, so
+    one recipe trained on 8 devices and re-meshed to 4 survivors at the
+    same global batch keeps one digest. Without ``data_size`` (pure
+    config-side callers) the layout keys stay in — a conservative
+    fallback that can only split series, never wrongly merge them."""
+    reduced = {
         k: v for k, v in config_snapshot.items()
         if k not in QUALITY_DIGEST_EXCLUDED
-    })
+    }
+    if data_size is not None:
+        for key in QUALITY_DIGEST_LAYOUT_KEYS:
+            reduced.pop(key, None)
+        per_shard = config_snapshot.get("per_shard_batch")
+        if isinstance(per_shard, int):
+            reduced["global_batch"] = per_shard * int(data_size)
+    return config_digest(reduced)
 
 
 def artifact_provenance(

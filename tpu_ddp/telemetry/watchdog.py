@@ -34,6 +34,13 @@ from typing import Callable, Optional
 
 log = logging.getLogger(__name__)
 
+#: exit code of a --watchdog-abort escalation. The goodput ledger's
+#: ``hang`` classification comes from the trace evidence (the
+#: ``watchdog_hang`` instant with no ``run_end``), not this code — but
+#: the elastic supervisor logs it, and a distinctive value keeps a
+#: watchdog abort distinguishable from a crash in process tables.
+HANG_EXIT_CODE = 113
+
 
 def read_heartbeat(path: str) -> Optional[dict]:
     """Parse one ``heartbeat-p<i>.json`` liveness file; None when the
@@ -81,6 +88,14 @@ class HangWatchdog:
         the ``watchdog/hangs`` counter.
     on_hang: optional callback(dump_text) — tests hook this.
     poll_interval: monitor wakeup period (default: deadline/4, min 10ms).
+    abort_on_hang: escalate after the dump — ``os._exit(HANG_EXIT_CODE)``
+        from the monitor thread, so a wedged runtime becomes a
+        RESTARTABLE death (the trace's ``watchdog_hang`` instant with no
+        ``run_end`` classifies it ``hang`` in the goodput ledger, and
+        the elastic supervisor's hang budget decides the restart)
+        instead of an eternal chip-burning stall. Opt-in
+        (``--watchdog-abort``): an unsupervised run may prefer the
+        wedge forensically intact.
     """
 
     def __init__(
@@ -92,6 +107,7 @@ class HangWatchdog:
         telemetry=None,
         on_hang: Optional[Callable[[str], None]] = None,
         poll_interval: Optional[float] = None,
+        abort_on_hang: bool = False,
     ):
         if deadline_seconds <= 0:
             raise ValueError("deadline_seconds must be > 0")
@@ -100,6 +116,7 @@ class HangWatchdog:
         self.process_index = process_index
         self.telemetry = telemetry
         self.on_hang = on_hang
+        self.abort_on_hang = abort_on_hang
         self.poll_interval = poll_interval or max(deadline_seconds / 4, 0.01)
         self.fire_count = 0
         self._last_beat = time.monotonic()
@@ -227,3 +244,16 @@ class HangWatchdog:
                 self.on_hang(header + dump)
             except Exception:
                 pass
+        if self.abort_on_hang:
+            # forensics are durable (JSONL sinks flush per line, the
+            # hang log is written above): escalate. os._exit on purpose
+            # — the main thread is the thing that is wedged, so a
+            # cooperative shutdown would hang exactly like the run did.
+            self._write_heartbeat(force=True)
+            os.write(
+                2,
+                b"\ntpu_ddp watchdog: --watchdog-abort escalation - "
+                b"aborting the wedged process (exit %d)\n"
+                % HANG_EXIT_CODE,
+            )
+            os._exit(HANG_EXIT_CODE)

@@ -1,0 +1,365 @@
+"""``make chaos-demo`` — end-to-end proof of the elastic runtime.
+
+The acceptance story (docs/resilience.md), run as one live circuit on
+the 8-virtual-device CPU mesh (exit nonzero on any miss; CI runs this
+beside curves-demo as a living gate):
+
+1. **Seed band first**: three seeded clean runs of the recipe (4
+   devices, global batch 64) extract through ``tpu-ddp curves --json``
+   and record into a scratch registry — the arbiter the recovered run
+   is judged against at the end. The band is seed-invariant AND
+   mesh-invariant by construction (the quality digest keys on the
+   global batch, not the layout), which is exactly what lets 4-device
+   baselines judge an 8→4 re-meshed run.
+2. **The incident**: ``tpu-ddp elastic train`` launches the same recipe
+   on 8 devices under a chaos spec with three faults — save-io-flake ×2
+   at the step-3 checkpoint (the retry path must absorb it),
+   checkpoint-corrupt of the newest save (step 6, after its manifest
+   lands), kill-host at step 8 with 4 survivors.
+3. **The recovery, without human input**: the supervisor must classify
+   ``killed``, back off, re-mesh 8→4 (global batch held), REFUSE the
+   corrupt step-6 checkpoint BY NAME, resume from verified step 3, and
+   the child must finish clean.
+4. **The accounting**: the goodput ledger must show exactly 2
+   incarnations (killed + clean), 5 replayed steps (kill at 8, resume
+   at 3), nonzero restart-gap, categories summing to elapsed within
+   2%, and the elastic decision join naming the whole story; the
+   incarnation-0 trace must carry ``checkpoint/save_retries == 2``.
+5. **The run still learned**: ``tpu-ddp curves --against`` the scratch
+   registry must PASS the recovered run against the clean seed band.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import shutil
+import sys
+
+
+def _fail(msg: str) -> None:
+    print(f"[chaos-demo] FAIL: {msg}", file=sys.stderr)
+
+
+def _cli(argv) -> tuple:
+    from tpu_ddp.cli.main import main as cli_main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(argv)
+    return rc, buf.getvalue()
+
+
+def _force_cpu(n: int) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+#: one recipe, two surfaces: the in-process baseline TrainConfig and the
+#: supervised child's CLI argv MUST describe the same learning recipe
+#: (the demo asserts the quality digests agree — a drift here is a bug)
+GLOBAL_BATCH = 64
+RECIPE = dict(
+    synthetic_data=True,
+    synthetic_size=640,
+    epochs=2,
+    momentum=0.9,
+    model="netresdeep",
+    n_chans1=8,
+    n_blocks=2,
+    prefetch_depth=0,
+    eval_each_epoch=True,
+    health="on",
+)
+
+
+def run_baseline(run_dir: str, *, seed: int) -> str:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tpu_ddp.train.trainer import TrainConfig, Trainer
+
+    cfg = TrainConfig(
+        **RECIPE,
+        n_devices=4,
+        per_shard_batch=GLOBAL_BATCH // 4,
+        seed=seed,
+        log_every_epochs=99,
+        telemetry_dir=run_dir,
+        telemetry_sinks="jsonl",
+    )
+    trainer = Trainer(cfg.validate())
+    metrics = trainer.run(close=False)
+    trainer.record_final_eval(accuracy=metrics.get("test_accuracy"))
+    trainer.close()
+    return trainer.run_meta["quality_digest"]
+
+
+def child_train_args(base: str, spec_path: str) -> list:
+    return [
+        "--device", "cpu",
+        "--synthetic-data", "--synthetic-size", str(RECIPE["synthetic_size"]),
+        "--epochs", str(RECIPE["epochs"]),
+        "--momentum", str(RECIPE["momentum"]),
+        "--model", RECIPE["model"],
+        "--n-chans1", str(RECIPE["n_chans1"]),
+        "--n-blocks", str(RECIPE["n_blocks"]),
+        "--prefetch-depth", str(RECIPE["prefetch_depth"]),
+        "--eval-each-epoch",
+        "--health", "on",
+        "--seed", "0",
+        "--n-devices", "8",
+        "--batch-size", str(GLOBAL_BATCH // 8),
+        "--global-batch-size", str(GLOBAL_BATCH),
+        "--log-every-epochs", "99",
+        "--telemetry-dir", os.path.join(base, "incident"),
+        "--telemetry-sinks", "jsonl",
+        "--telemetry-snapshot-steps", "2",
+        "--checkpoint-dir", os.path.join(base, "ckpt"),
+        "--checkpoint-steps", "3",
+        "--chaos", spec_path,
+    ]
+
+
+CHAOS_SPEC = {
+    "chaos_schema_version": 1,
+    "seed": 0,
+    "faults": [
+        # step-3 cadence save: two transient IO failures, then success
+        {"kind": "save_io_flake", "step": 3, "times": 2},
+        # the newest save (step 6) is bit-flipped AFTER commit+manifest
+        {"kind": "checkpoint_corrupt", "step": 7, "await_step": 6},
+        # host loss: hard exit, no drain; the scheduler reports 4
+        # survivors into capacity.json
+        {"kind": "kill_host", "step": 8, "survivors": 4},
+    ],
+}
+
+KILL_STEP = 8
+VERIFIED_STEP = 3
+CORRUPT_STEP = 6
+
+
+def newest_counter(trace_path: str, name: str):
+    """The newest counters snapshot's value for ``name`` in a JSONL
+    trace (None when never recorded)."""
+    value = None
+    with open(trace_path) as f:
+        for line in f:
+            try:
+                record = json.loads(line)
+            except ValueError:
+                continue
+            if record.get("type") != "counters":
+                continue
+            counters = (record.get("attrs") or {}).get("counters") or {}
+            if name in counters:
+                value = counters[name]
+    return value
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="elastic-runtime acceptance demo: supervised chaos "
+                    "run with kill -> re-mesh -> verified recovery "
+                    "(docs/resilience.md)")
+    ap.add_argument("--dir", default="/tmp/tpu_ddp_chaos_demo")
+    ap.add_argument("--devices", type=int, default=8)
+    args = ap.parse_args(argv)
+    _force_cpu(args.devices)
+    base = args.dir
+    shutil.rmtree(base, ignore_errors=True)
+    os.makedirs(base, exist_ok=True)
+    registry = os.path.join(base, "registry")
+    ok = True
+
+    from tpu_ddp.telemetry.provenance import git_provenance
+
+    dirty = git_provenance().get("git_dirty") is not False
+    dirty_flag = ["--allow-dirty"] if dirty else []
+
+    # -- 1. seed band (3 clean baselines on 4 devices) -------------------
+    digests = set()
+    for seed in (1, 2, 3):
+        run_dir = os.path.join(base, f"seed{seed}")
+        digests.add(run_baseline(run_dir, seed=seed))
+        art_path = os.path.join(base, f"seed{seed}.json")
+        rc, out = _cli(["curves", run_dir, "--json"])
+        if rc != 0:
+            _fail(f"curves extraction of baseline seed {seed} exited {rc}")
+            return 1
+        with open(art_path, "w") as f:
+            f.write(out)
+        rc, _ = _cli(["registry", "--registry", registry, "record",
+                      art_path])
+        if rc != 0:
+            _fail(f"registry record of baseline seed {seed} exited {rc}")
+            ok = False
+    if len(digests) != 1:
+        _fail(f"baselines must share one quality digest, got {digests}")
+        ok = False
+    band_digest = next(iter(digests))
+    print(f"[chaos-demo] 3 clean baselines (4 devices, global batch "
+          f"{GLOBAL_BATCH}) archived under quality digest {band_digest}",
+          flush=True)
+
+    # -- 2+3. the supervised incident ------------------------------------
+    spec_path = os.path.join(base, "chaos.json")
+    with open(spec_path, "w") as f:
+        json.dump(CHAOS_SPEC, f, indent=1)
+    incident = os.path.join(base, "incident")
+    rc, out = _cli([
+        "elastic", "--backoff-base", "0.2", "--max-restarts", "killed=3",
+        "train", *child_train_args(base, spec_path),
+    ])
+    print(out, flush=True)
+    if rc != 0:
+        _fail(f"supervised chaos run exited {rc} — the faults were not "
+              "recovered without human input")
+        return 1
+    print("[chaos-demo] supervisor finished clean (every fault "
+          "recovered)", flush=True)
+
+    # -- decision log: the recovery BY NAME ------------------------------
+    from tpu_ddp.elastic.recovery import read_decisions
+
+    decisions = read_decisions(incident)
+    restarts = [d for d in decisions if d.get("event") == "restart"]
+    if len(restarts) != 1:
+        _fail(f"expected exactly 1 restart decision, got "
+              f"{len(restarts)} ({[d.get('event') for d in decisions]})")
+        ok = False
+    else:
+        d = restarts[0]
+        plan = d.get("plan") or {}
+        recovery = d.get("recovery") or {}
+        refused_steps = [r.get("step") for r in recovery.get("refused") or []]
+        if d.get("exit_class") != "killed":
+            _fail(f"restart classified {d.get('exit_class')!r}, expected "
+                  "'killed'")
+            ok = False
+        if plan.get("n_devices") != 4:
+            _fail(f"re-mesh planned {plan.get('n_devices')} devices, "
+                  "expected 4 survivors")
+            ok = False
+        if recovery.get("resume_step") != VERIFIED_STEP:
+            _fail(f"recovery resume step {recovery.get('resume_step')}, "
+                  f"expected verified step {VERIFIED_STEP}")
+            ok = False
+        if refused_steps != [CORRUPT_STEP]:
+            _fail(f"the corrupt step {CORRUPT_STEP} must be refused BY "
+                  f"NAME in the decision log, got refused={refused_steps}")
+            ok = False
+        if (d.get("backoff_s") or 0) <= 0:
+            _fail("restart decision carries no backoff")
+            ok = False
+        if ok:
+            print(f"[chaos-demo] decision log: killed -> restart "
+                  f"(backoff {d['backoff_s']}s) -> re-mesh 8->4 -> "
+                  f"step {CORRUPT_STEP} REFUSED by manifest -> resume "
+                  f"from verified step {VERIFIED_STEP}", flush=True)
+
+    # -- flaky save was retried ------------------------------------------
+    retries = newest_counter(
+        os.path.join(incident, "trace-p0.jsonl"),
+        "checkpoint/save_retries")
+    if retries != 2:
+        _fail(f"checkpoint/save_retries in the killed life's trace is "
+              f"{retries}, expected 2 (save-io-flake x2 absorbed)")
+        ok = False
+    else:
+        print("[chaos-demo] flaky save: 2 injected IO failures absorbed "
+              "by the retry path (checkpoint/save_retries == 2)",
+              flush=True)
+
+    # -- 4. the ledger accounting ----------------------------------------
+    rc, out = _cli(["goodput", incident, "--json"])
+    if rc != 0:
+        _fail(f"tpu-ddp goodput --json exited {rc}")
+        return 1
+    ledger = json.loads(out)["ledger"]
+    incs = ledger["incarnations"]
+    if [i["exit"] for i in incs] != ["killed", "clean"]:
+        _fail(f"expected exits [killed, clean], got "
+              f"{[i['exit'] for i in incs]}")
+        ok = False
+    if incs and incs[-1]["replayed_steps"] != KILL_STEP - VERIFIED_STEP:
+        _fail(f"replayed_steps {incs[-1]['replayed_steps']}, expected "
+              f"{KILL_STEP - VERIFIED_STEP} (kill at {KILL_STEP}, "
+              f"verified resume at {VERIFIED_STEP})")
+        ok = False
+    cats = ledger["category_seconds"]
+    if cats.get("restart_gap", 0.0) <= 0:
+        _fail("restart_gap badput is zero in the incident ledger")
+        ok = False
+    total = sum(cats.values())
+    if abs(total - ledger["elapsed_s"]) > 0.02 * ledger["elapsed_s"]:
+        _fail(f"categories sum to {total:.2f}s but elapsed is "
+              f"{ledger['elapsed_s']:.2f}s (beyond the 2% identity)")
+        ok = False
+    joined = ledger.get("elastic", {}).get("decisions", [])
+    if len(joined) != len(decisions):
+        _fail("the ledger --json did not join the elastic decision log")
+        ok = False
+    rc, out = _cli(["goodput", incident])
+    if rc != 0 or "elastic decisions" not in out:
+        _fail("the goodput text report did not render the elastic "
+              "decision join")
+        ok = False
+    if ok:
+        print(f"[chaos-demo] ledger: 2 incarnations (killed+clean), "
+              f"{incs[-1]['replayed_steps']} replayed steps, restart "
+              f"gap {cats['restart_gap']:.2f}s, categories sum to "
+              f"elapsed within 2%, decisions joined", flush=True)
+    ledger_path = os.path.join(base, "incident_ledger.json")
+    with open(ledger_path, "w") as f:
+        json.dump({"schema_version": 1, "type": "goodput_ledger",
+                   "ledger": ledger}, f)
+
+    # -- 5. the recovered run still LEARNED ------------------------------
+    rc, out = _cli(["curves", incident, "--against", registry,
+                    *dirty_flag, "--json"])
+    if rc != 0 or not out.strip():
+        findings = []
+        try:
+            findings = [f["rule"] for f in
+                        json.loads(out).get("findings", [])]
+        except ValueError:
+            pass
+        _fail(f"the recovered run must pass the clean seed band "
+              f"(curves --against exited {rc}, findings {findings}) — "
+              "the re-meshed run did not demonstrably learn")
+        ok = False
+    else:
+        art = json.loads(out)
+        if art["curve"]["quality_digest"] != band_digest:
+            _fail("the incident run's quality digest "
+                  f"{art['curve']['quality_digest']} differs from the "
+                  f"band's {band_digest}: the digest is not "
+                  "mesh-invariant")
+            ok = False
+        else:
+            print(f"[chaos-demo] curves --against: the recovered 8->4 "
+                  f"run PASSED the 4-device seed band (digest "
+                  f"{band_digest}, {art['curve'].get('incarnations')} "
+                  "incarnations stitched) — it still learned",
+                  flush=True)
+
+    # accumulate the incident ledger into the CI registry workspace
+    from tpu_ddp.registry.store import record_if_env
+
+    record_if_env(ledger_path, note="chaos-demo incident ledger")
+
+    print(f"[chaos-demo] {'PASS' if ok else 'FAIL'}", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

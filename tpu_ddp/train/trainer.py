@@ -245,6 +245,20 @@ class TrainConfig:
     watchdog_deadline_seconds: float = 0.0  # >0: hang watchdog — stack
                                           # dump + heartbeat staleness when
                                           # no step completes in time
+    watchdog_abort: bool = False          # escalate after the dump: exit
+                                          # with the `hang` class
+                                          # (HANG_EXIT_CODE) so a wedged
+                                          # runtime becomes supervisor-
+                                          # restartable instead of an
+                                          # eternal stall
+                                          # (docs/resilience.md)
+    chaos_spec: Optional[str] = None      # fault-injection spec JSON
+                                          # (chaos/inject.py): step-
+                                          # triggered kill/hang/corrupt/
+                                          # io-flake/stall faults, seeded
+                                          # and fire-once per logical run
+                                          # — the elastic runtime's CI
+                                          # harness (docs/resilience.md)
     health: str = "off"                   # "on": numerics flight recorder —
                                           # in-graph grad/param/update norms
                                           # + NaN/Inf sentinels every step
@@ -349,6 +363,23 @@ class TrainConfig:
             raise ValueError(
                 f"health_window must be >= 4, got {self.health_window}"
             )
+        if self.watchdog_abort and self.watchdog_deadline_seconds <= 0:
+            raise ValueError(
+                "--watchdog-abort needs --watchdog-deadline > 0: there "
+                "is no hang detector to escalate from"
+            )
+        if self.chaos_spec:
+            if not self.telemetry_dir:
+                raise ValueError(
+                    "--chaos needs --telemetry-dir: the fire-once fault "
+                    "state lives in the run dir (and an unobserved "
+                    "chaos run proves nothing)"
+                )
+            from tpu_ddp.chaos.inject import load_spec
+
+            # parse + validate NOW: a typo'd fault spec must refuse the
+            # launch, not detonate at its trigger step
+            load_spec(self.chaos_spec)
         if self.zero1 and self.optimizer == "lamb":
             raise ValueError(
                 "--zero1 does not compose with --optimizer lamb (the "
@@ -556,7 +587,8 @@ class Trainer:
             # learning recipe share it, so the convergence observatory
             # (docs/curves.md) can build seed-band baselines across runs
             # whose run_ids all differ
-            "quality_digest": quality_digest(config_snapshot),
+            "quality_digest": quality_digest(
+                config_snapshot, data_size=self.data_size),
             "incarnation": self.incarnation,
             "config": config_snapshot,
             "jax_version": jax.__version__,
@@ -643,6 +675,21 @@ class Trainer:
             window = parse_profile_steps(config.profile_steps)
             if window:
                 self._capture.arm_window(*window)
+
+        # Chaos injector (docs/resilience.md): deterministic step-
+        # triggered fault injection — exists exactly when --chaos is
+        # given; its save_fault_hook threads into the Checkpointer below
+        self._chaos = None
+        if config.chaos_spec:
+            from tpu_ddp.chaos.inject import ChaosInjector
+
+            self._chaos = ChaosInjector(
+                config.chaos_spec,
+                config.telemetry_dir,
+                process_index=self.process_index,
+                checkpoint_dir=config.checkpoint_dir,
+                telemetry=self.telemetry,
+            )
 
         # Live memory sampler (docs/memory.md): per-step device
         # memory_stats -> memory/* gauges + the incarnation-stamped
@@ -757,7 +804,11 @@ class Trainer:
             from tpu_ddp.checkpoint import Checkpointer
 
             self.checkpointer = Checkpointer(
-                config.checkpoint_dir, telemetry=self.telemetry
+                config.checkpoint_dir, telemetry=self.telemetry,
+                fault_hook=(
+                    self._chaos.save_fault_hook
+                    if self._chaos is not None else None
+                ),
             )
             if config.keep_best:
                 best_dir = os.path.join(config.checkpoint_dir, "best")
@@ -782,24 +833,30 @@ class Trainer:
             if config.resume and self.checkpointer.latest_step() is not None:
                 from tpu_ddp.parallel.mesh import replicated_sharding
 
+                # Checkpoints are ALWAYS the de-sharded, device-count-
+                # independent layout — _ckpt_state below: zero1 opt
+                # shards gathered back to the original optax layout, the
+                # error-feedback residual de-flattened to param layout —
+                # so a --zero1/--grad-compress run restores a replicated
+                # run's checkpoint and vice versa, AND a checkpoint cut
+                # on one device count resumes on another (the elastic
+                # re-mesh path, docs/resilience.md). Restore through the
+                # de-sharded template, then re-scatter onto THIS mesh.
+                restored = self._restore_checkpoint(self._ckpt_state())
+                if (self._compress is not None
+                        and restored.grad_residual is not None):
+                    restored = restored.replace(
+                        grad_residual=self._compress.shard_residual(
+                            restored.grad_residual, self.mesh))
                 if self._zero1 is not None:
-                    # Checkpoints are ALWAYS the de-sharded (replicated-
-                    # layout) state — _ckpt_state below — so a --zero1 run
-                    # restores a replicated run's checkpoint and vice
-                    # versa. Restore through the de-sharded template, then
-                    # re-scatter the optimizer state onto the mesh.
-                    restored = self._restore_checkpoint(
-                        self._zero1.deshard_state(self.state)
-                    )
                     self.state = self._zero1.shard_state(restored, self.mesh)
                 else:
-                    restored = self._restore_checkpoint(self.state)
                     # Lay restored arrays back out in the TRAINING layout:
                     # the sharded strategies (fsdp/tp/pp/ep) resume
                     # scattered, the replicated ones (dp/sp) resume
-                    # replicated — the restore template (self.state)
-                    # already carries the right shardings, this device_put
-                    # just pins the invariant.
+                    # replicated — the state shardings already carry the
+                    # right layout (incl. the residual's P(data)), this
+                    # device_put just pins the invariant.
                     self.state = jax.device_put(
                         restored,
                         self.state_shardings
@@ -1491,25 +1548,42 @@ class Trainer:
         # what makes training survive TPU-pod preemptions and Ctrl-C
         # identically.
         self._preempted = False
+        self._force_abort = False
         import signal
 
         old_handlers = {}
 
         def _on_signal(signum, frame):
             del frame
-            self._preempted = True
             # Async-signal-safe only: no print()/logging here (a buffered
             # write interrupted mid-print would raise a reentrancy error);
             # os.write to stderr is safe. The loop logs properly later.
+            if self._preempted:
+                # Second signal during the drain: escalate by SKIPPING
+                # the final checkpoint — NOT by dying wherever we stand,
+                # which could be mid-save and would leave a torn newest
+                # checkpoint for the next --resume to trip over (the
+                # checksum manifest would catch it, but the cadence save
+                # it falls back to is older than the one a clean skip
+                # preserves). A third signal gets the previous handler
+                # (hard kill) — the escape hatch for a wedged drain.
+                self._force_abort = True
+                os.write(
+                    2,
+                    b"\ntpu_ddp: second signal - force-abort: skipping "
+                    b"the final checkpoint, exiting at the next "
+                    b"boundary (send again to kill outright)\n",
+                )
+                signal.signal(
+                    signum, old_handlers.get(signum, signal.SIG_DFL))
+                return
+            self._preempted = True
             os.write(
                 2,
                 b"\ntpu_ddp: signal received - draining, will checkpoint "
-                b"and exit (send again to force-abort)\n",
+                b"and exit (send again to force-abort without the final "
+                b"checkpoint)\n",
             )
-            # Second signal force-aborts: restore the previous handler so
-            # e.g. a repeated Ctrl-C raises KeyboardInterrupt even while
-            # the main thread is stuck in a long XLA compile.
-            signal.signal(signum, old_handlers.get(signum, signal.SIG_DFL))
 
         try:
             for sig in (signal.SIGTERM, signal.SIGINT):
@@ -1595,6 +1669,20 @@ class Trainer:
         )
         return bool(np.asarray(flags).max())
 
+    def _force_abort_agreed(self) -> bool:
+        """Cross-host agreement on the second-signal force-abort flag:
+        the final checkpoint save is a cross-process collective, so
+        skipping it must be unanimous-on-any — one host skipping while
+        the others save would wedge the pod in the save barrier."""
+        if self.process_count == 1:
+            return self._force_abort
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([self._force_abort], dtype=np.int32)
+        )
+        return bool(np.asarray(flags).max())
+
     def _run_loop(self, c, start) -> dict:
         # Multi-host: this process only counts its LOCAL rows (the loader
         # yields the local slice), so rate against local chips; the per-chip
@@ -1628,6 +1716,7 @@ class Trainer:
                 heartbeat_dir=c.telemetry_dir,
                 process_index=self.process_index,
                 telemetry=tel,
+                abort_on_hang=c.watchdog_abort,
             ).start()
         if c.monitor_port:
             # Per-host live scrape endpoint (docs/monitoring.md). A bind
@@ -1711,6 +1800,7 @@ class Trainer:
                 or self._watchdog is not None
                 or self._health_monitor is not None
                 or self._memtrack is not None
+                or self._chaos is not None
                 or (self.checkpointer is not None
                     and c.checkpoint_steps > 0)
             )
@@ -1781,6 +1871,11 @@ class Trainer:
                     # still catches wedged collectives (the host blocks
                     # inside the NEXT dispatch when the device queue jams)
                     self._watchdog.beat(host_step)
+                if self._chaos is not None:
+                    # AFTER the beat: an injected hang blocks the loop
+                    # here, so the beat above is the last one — exactly
+                    # the silhouette of a wedged collective
+                    self._chaos.on_step(host_step)
                 if self._capture is not None:
                     # capture-window lifecycle: opens an armed window when
                     # its start step arrives, closes + writes the bundle
@@ -2010,6 +2105,22 @@ class Trainer:
         # reference wall-clock line: main.py:49
         self.logger.log_text(f"training time: {total:.3f} seconds")
         save_final = self.checkpointer is not None
+        if save_final and self._force_abort_agreed():
+            # second-SIGTERM escalation: the operator (or the job
+            # system's kill sequence) wants OUT — skip the final save
+            # rather than risk dying inside it; the last cadence/epoch
+            # checkpoint remains the verified resume point
+            save_final = False
+            prev = self.checkpointer.latest_step()
+            self.logger.log_text(
+                "force-abort: skipping the final checkpoint ("
+                + (f"latest checkpoint remains step {prev}"
+                   if prev is not None else "no checkpoint exists")
+                + ")"
+            )
+            if tel.enabled:
+                tel.instant("force_abort_drain",
+                            step=int(self.state.step))
         if save_final and self._health_halted is not None:
             # A halt on a NON-FINITE anomaly means the poisoned update was
             # applied (halt compiles no skip guard): checkpointing that
@@ -2168,12 +2279,20 @@ class Trainer:
     def _ckpt_state(self):
         """The state a checkpoint should persist: under --zero1 the
         scattered optimizer state is de-sharded back to the ORIGINAL optax
-        layout first, so every checkpoint on disk has one format and
-        --resume composes with --zero1 in either direction (restore
-        re-scatters; see __init__)."""
+        layout, and the error-feedback residual is de-flattened to param
+        layout (its per-device row-sum — the device-count-independent
+        quantity), so every checkpoint on disk has ONE format and
+        --resume composes with --zero1/--grad-compress in either
+        direction AND across a device-count change (restore re-scatters;
+        see __init__ and docs/resilience.md)."""
+        state = self.state
         if self._zero1 is not None:
-            return self._zero1.deshard_state(self.state)
-        return self.state
+            state = self._zero1.deshard_state(state)
+        if self._compress is not None and state.grad_residual is not None:
+            state = state.replace(
+                grad_residual=self._compress.deshard_residual(
+                    state.grad_residual))
+        return state
 
     def _eval_source_state(self):
         """The state eval/predict should read weights from: the EMA shadow
