@@ -467,6 +467,8 @@ def test_health_reports_compress_error_norm(devices):
     _trees_close(res_before, jax.device_get(s.grad_residual), atol=0)
 
 
+@pytest.mark.slow  # ~50s: the heaviest compile in the file; the int8/zero1
+# composition pins stay fast — make test-all
 def test_sp_strategy_composition(devices):
     """build_strategy routes --grad-compress through the SP step (f32
     mode == uncompressed SP trajectory; the compressor + residual ride

@@ -167,6 +167,7 @@ def test_grad_accum_parity_bitwise(devices):
     assert bool(np.asarray(m["health"]["all_finite"]))
 
 
+@pytest.mark.slow  # ~25s SP compile; dp/pipeline parity stay fast — make test-all
 def test_sp_parity_bitwise(devices):
     from tpu_ddp.models.vit import ViT
     from tpu_ddp.parallel.sequence_parallel import make_sp_train_step

@@ -119,6 +119,7 @@ def test_bottleneck_map_covers_resnet50():
     assert want.keys() == got.keys()
 
 
+@pytest.mark.slow  # ~28s finetune e2e; the map/roundtrip pins stay fast — make test-all
 def test_head_swap_finetune_e2e(tmp_path):
     """The reference flow (ppe_main_ddp.py:104-111): ImageNet-layout
     weights -> new head width -> --pretrained-dir FILE -> one training

@@ -656,6 +656,7 @@ def test_trainer_config_window_end_to_end(tmp_path):
     assert "per-op attribution" in text
 
 
+@pytest.mark.slow  # ~16s; the config-window e2e keeps the fast lane — make test-all
 def test_trainer_post_profile_arms_live_capture(tmp_path):
     """POST /profile on the live exporter arms a window mid-run — the
     operator path, exercised against a real Trainer."""

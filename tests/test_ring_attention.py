@@ -319,6 +319,7 @@ def test_ring_flash_causal_matches_reference(devices):
                                    atol=5e-5, rtol=0)
 
 
+@pytest.mark.slow  # ~16s; six sibling ring-flash pins stay fast — make test-all
 def test_ring_flash_kv_mask_rotates_with_blocks(devices):
     """Key-padding: the (B, T_local) mask shard rotates around the ring
     with its K/V chunk; ragged + prefix masking under causal produces dead

@@ -377,6 +377,7 @@ def test_zero1_sp_lm_parity(devices):
     _trees_close(s_rep.params, s_z.params)
 
 
+@pytest.mark.slow  # ~35s SP compile; zero1+sp LM parity stays fast — make test-all
 def test_zero1_sp_strategy_parity(devices):
     """build_strategy routes --zero1 through the SP image step; the
     trajectory matches the replicated SP strategy and the strategy carries
@@ -452,6 +453,8 @@ def _trainer_config(tmp_path, zero1, *, resume=False, epochs=2, ckpt=True):
     )
 
 
+@pytest.mark.slow  # ~25s per direction (two Trainers each); the cross-layout
+# elastic resume pin covers the scatter/gather math — make test-all
 @pytest.mark.parametrize("first,second", [(True, False), (False, True)])
 def test_zero1_checkpoint_roundtrip(tmp_path, devices, first, second):
     """--resume composes with --zero1 in EITHER direction: a run trains
@@ -476,6 +479,7 @@ def test_zero1_checkpoint_roundtrip(tmp_path, devices, first, second):
     _trees_close(ref_opt, b_opt, atol=1e-4)
 
 
+@pytest.mark.slow  # ~22s; test_ema covers the trainer EMA path — make test-all
 def test_zero1_trainer_ema_eval(devices):
     """--ema-decay composes: the EMA shadow lives as update-space shards
     inside the scattered opt state, and eval de-flattens it back — final

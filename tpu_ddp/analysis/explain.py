@@ -577,6 +577,15 @@ def join_measurements(anatomy: StepAnatomy, rl: RooflineReport,
             for p in ("data_wait", "h2d", "compiled_step", "device_sync")]
     if sum(loop):
         joined["data_wait_share"] = loop[0] / sum(loop)
+    # measured exposed-comm attribution (`tpu-ddp comms exposure`,
+    # docs/comms.md): the comm share that actually stayed exposed, to
+    # set against the modeled comm_share_of_step above
+    from tpu_ddp.comms.exposure import read_exposure
+
+    exp = read_exposure(run_dir)
+    if exp is not None:
+        joined["measured_comm_share"] = exp.get("measured_comm_share")
+        joined["exposed_comm_s"] = exp.get("exposed_comm_s")
     return joined
 
 
@@ -683,8 +692,15 @@ def render_report(anatomy: StepAnatomy, rl: RooflineReport,
         if "comm_share_of_step" in joined:
             lines.append(
                 f"  comm share of step    = "
-                f"{joined['comm_share_of_step']:.1%} (roofline ici / "
-                "measured step)"
+                f"{joined['comm_share_of_step']:.1%} (MODELED: roofline "
+                "ici / measured step)"
+            )
+        if joined.get("measured_comm_share") is not None:
+            lines.append(
+                f"  exposed comm share    = "
+                f"{joined['measured_comm_share']:.1%} (MEASURED: "
+                f"{_human_time(joined.get('exposed_comm_s'))} vs the "
+                "comm-stripped twin, tpu-ddp comms exposure)"
             )
         if "data_wait_share" in joined:
             lines.append(

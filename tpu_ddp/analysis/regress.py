@@ -17,7 +17,7 @@ fusion choices and temp bytes is real). Wall-clock fields
 (``compile_wall_s``) are reported, never gated — they measure the build
 machine, not the program.
 
-Understands eight artifact shapes: ``benchmarks/aot_v5e.json``-style
+Understands nine artifact shapes: ``benchmarks/aot_v5e.json``-style
 (``{"programs": {name: record}}``), ``tpu-ddp analyze --json`` output
 (``{"anatomy": ...}``), ``tpu-ddp goodput --json`` ledgers
 (``{"ledger": ...}`` — badput category presence AND failure-exit
@@ -32,8 +32,11 @@ phase percentiles: report-only here, trend-gated by the registry),
 ``tpu-ddp curves --json`` learning curves (``{"curve": ...}`` — the
 final eval accuracy gates as a higher-is-better quality metric, the
 final eval loss and time-to-target steps as unit-scale sizes, and CRV
-rule counts exactly through the shared rule-count channel), and a
-bare single program record.
+rule counts exactly through the shared rule-count channel), ``tpu-ddp
+comms bench --json`` measured interconnect models (``{"comms": ...}``
+— the best measured link bandwidth gates as a higher-is-better
+quality metric, the median fitted α latency as a unit-scale size),
+and a bare single program record.
 Stdlib-only — no jax import — so it gates anywhere the JSON lands.
 
 ``--against <registry-dir>`` replaces the hand-pointed baseline file
@@ -55,14 +58,14 @@ _SIZE_KEYS = (
     "argument_bytes", "output_bytes", "temp_bytes", "peak_bytes",
     "flops", "bytes_accessed", "predicted_step_us",
     "measured_high_water_bytes",
-    "time_to_target_steps", "final_eval_loss",
+    "time_to_target_steps", "final_eval_loss", "alpha_s",
 )
 _SIZE_NOISE_FLOOR = 1024
 
 #: sized keys at UNIT scale (a loss ~2.3, a step count ~100): the 1 KiB
 #: byte-noise floor would swallow them entirely, so these gate on the
 #: relative tolerance alone
-_UNIT_SIZE_KEYS = ("time_to_target_steps", "final_eval_loss")
+_UNIT_SIZE_KEYS = ("time_to_target_steps", "final_eval_loss", "alpha_s")
 
 #: count metrics (exact): any increase is a regression
 _COUNT_KEYS = ("s8_collective_permute_count", "f32_collective_permute_count",
@@ -95,7 +98,7 @@ _WALL_KEYS = ("compile_wall_s", "elapsed_s")
 #: regression, a rise an improvement — mirroring the sized-metric gate
 #: with the sign flipped
 _QUALITY_KEYS = ("goodput_fraction", "predicted_images_per_sec_per_chip",
-                 "final_eval_accuracy")
+                 "final_eval_accuracy", "achieved_bw_bytes_per_s")
 
 
 def load_artifact(path: str) -> Dict[str, dict]:
@@ -140,6 +143,14 @@ def normalize_artifact(art, path: str = "<artifact>") -> Dict[str, dict]:
         # CRV rule counts exactly (the shared rule-count channel — a
         # fresh CRV finding regresses like a new lint finding)
         return {"curves": art["curve"]}
+    if "comms_schema_version" in art and isinstance(
+            art.get("comms"), dict):
+        # `tpu-ddp comms bench --json`: the headline achieved bandwidth
+        # gates as quality (a measured link slowdown is a regression),
+        # the median fitted α as a unit-scale size; raw sweeps are
+        # evidence, not gates
+        return {"comms": {k: v for k, v in art["comms"].items()
+                          if k not in ("sweeps", "skipped")}}
     if art.get("type") == "trace_summary" and isinstance(
             art.get("phases"), dict):
         # `tpu-ddp trace summarize --json`: measured per-phase

@@ -150,14 +150,61 @@ class RooflineReport:
         return rec
 
 
+def _ici_term(anatomy, spec, comms_model, notes: List[str]):
+    """The roofline's collective-time term. With a measured comms model
+    (``comms/model.py``), every inventoried collective is priced through
+    its fitted α-β line (``count·α + wire/β``, measured ``tpu-ddp comms
+    bench`` evidence); collectives the model has no evidence for fall
+    back to the spec-sheet link bandwidth. Without a model, the whole
+    term is the classic single-link ``wire / ici_bw``."""
+    wire = sum(c.wire_bytes for c in anatomy.collectives)
+    if not wire:
+        return 0.0
+    spec_bw = spec.ici_bw if spec else None
+    if comms_model:
+        total = 0.0
+        fallback_wire = 0
+        for c in anatomy.collectives:
+            t = comms_model.time_for(
+                c.kind, c.dtype, c.axis, c.wire_bytes, count=c.count)
+            if t is not None:
+                total += t
+            else:
+                fallback_wire += c.wire_bytes
+        if fallback_wire and spec_bw:
+            total += fallback_wire / spec_bw
+        elif fallback_wire:
+            notes.append(
+                f"comms model has no evidence for {fallback_wire} wire "
+                "bytes of collectives and the chip has no spec-sheet "
+                "link bandwidth: those collectives are unpriced"
+            )
+        notes.append(
+            "ici term uses the measured comms model "
+            f"(source {comms_model.source})"
+        )
+        return total
+    # one link of ICI: the conservative single-ring assumption (a 2-D/3-D
+    # torus can stripe a ring over more links; that would shrink this term)
+    return wire / spec_bw if spec_bw else None
+
+
 def roofline(anatomy, chip: Optional[str] = None, *,
-             overlap: str = "overlapped") -> RooflineReport:
+             overlap: str = "overlapped",
+             comms_model=None) -> RooflineReport:
     """Attribute ``anatomy`` (a StepAnatomy) onto ``chip``'s roofline.
 
     ``chip`` defaults to the anatomy's own device kind; pass a short key
     ("v5e") to ask how a CPU-compiled program would sit on real hardware
     (the cost model's flops/bytes/collective inventory are properties of
     the partitioned program, not of the executing backend).
+
+    ``comms_model`` (a ``comms/model.py`` LinkModel with evidence)
+    replaces the spec-sheet ICI term with measured per-link α-β pricing.
+    It also unlocks peak-less chips (CPU hosts): compute/hbm stay
+    unquantified, but the comm term is real measurement, so the report
+    carries a comm-only prediction (``bound="ici"``) instead of
+    refusing outright.
     """
     if overlap not in ("overlapped", "serial"):
         raise ValueError(
@@ -173,6 +220,19 @@ def roofline(anatomy, chip: Optional[str] = None, *,
         )
     if spec is None or spec.peak_bf16_flops is None:
         kind = spec.key if spec else (chip or anatomy.device_kind)
+        if comms_model:
+            ici_s = _ici_term(anatomy, spec, comms_model, notes)
+            return RooflineReport(
+                chip=spec.key if spec else None, overlap=overlap,
+                compute_s=None, hbm_s=None, ici_s=ici_s,
+                bound="ici" if ici_s else "unknown",
+                predicted_step_s=ici_s or None,
+                notes=notes + [
+                    f"no published peak for {kind!r}: compute/hbm terms "
+                    "unquantified — prediction covers the MEASURED comm "
+                    "term only"
+                ],
+            )
         return RooflineReport(
             chip=spec.key if spec else None, overlap=overlap,
             compute_s=None, hbm_s=None, ici_s=None,
@@ -188,10 +248,7 @@ def roofline(anatomy, chip: Optional[str] = None, *,
                  if anatomy.flops else None)
     hbm_s = (anatomy.bytes_accessed / spec.hbm_bw
              if anatomy.bytes_accessed and spec.hbm_bw else None)
-    wire = sum(c.wire_bytes for c in anatomy.collectives)
-    # one link of ICI: the conservative single-ring assumption (a 2-D/3-D
-    # torus can stripe a ring over more links; that would shrink this term)
-    ici_s = (wire / spec.ici_bw if spec.ici_bw else None) if wire else 0.0
+    ici_s = _ici_term(anatomy, spec, comms_model, notes)
     if anatomy.flops is None:
         notes.append("cost model exposed no flops: compute term missing")
     if anatomy.bytes_accessed is None:

@@ -22,6 +22,16 @@ Five fault kinds, each modeled on a failure the fleet actually suffers
   bounded-backoff retry path must absorb it.
 - ``data_stall`` — sleeps the input pipeline at a step (the DWT-class
   slow-loader incident).
+- ``comm_stall`` — stalls the gradient ring mid-collective: a
+  deterministic per-hop delay raised from the ring hop hook seam
+  (``parallel/collectives.py::set_ring_hop_hook``, ridden by the comms
+  hop monitor — the seam mirror of the Checkpointer's ``fault_hook``).
+  The first ``hops`` hops at/after the trigger step each sleep
+  ``delay_s`` inside the collective, so the hop monitor's health file
+  names the stalled collective ``in_flight`` while the step wedges —
+  the straggler-link / stuck-collective incident the COM001 alert and
+  the hang forensics exist for. Needs ``--comms-monitor`` on the run
+  (the hook seam is only installed then).
 
 Determinism contract: faults are keyed by list position (``fault id``),
 trigger on ``(process_index, step)``, and fire ONCE PER LOGICAL RUN —
@@ -57,6 +67,7 @@ FAULT_KINDS = (
     "checkpoint_corrupt",
     "save_io_flake",
     "data_stall",
+    "comm_stall",
 )
 
 _STATE_FILE = "chaos-state.json"
@@ -124,6 +135,15 @@ def load_spec(path: str) -> dict:
             if not isinstance(stall, (int, float)) or stall < 0:
                 raise ValueError(f"{label}: 'stall_s' must be a number "
                                  f">= 0, got {stall!r}")
+        if kind == "comm_stall":
+            delay = fault.get("delay_s", 30.0)
+            if not isinstance(delay, (int, float)) or delay <= 0:
+                raise ValueError(f"{label}: 'delay_s' must be a number "
+                                 f"> 0, got {delay!r}")
+            hops = fault.get("hops", 1)
+            if not isinstance(hops, int) or hops < 1:
+                raise ValueError(f"{label}: 'hops' must be an int >= 1, "
+                                 f"got {hops!r}")
     seed = spec.get("seed", 0)
     if not isinstance(seed, int):
         raise ValueError(f"chaos spec {path!r}: 'seed' must be an int")
@@ -154,6 +174,10 @@ class ChaosInjector:
         self.seed = int(self.spec.get("seed", 0))
         self.faults = list(self.spec["faults"])
         self._state = self._load_state()
+        # the last step the loop finished (on_step runs AFTER a step
+        # executes, so during step N this reads N-1): the comm_stall
+        # hook fires mid-collective INSIDE step N when N >= its trigger
+        self._last_step: Optional[int] = None
         for i, fault in enumerate(self.faults):
             if (fault["kind"] == "checkpoint_corrupt"
                     and not self.checkpoint_dir
@@ -176,6 +200,7 @@ class ChaosInjector:
             state = {}
         state.setdefault("fired", [])
         state.setdefault("flake_remaining", {})
+        state.setdefault("stall_remaining", {})
         return state
 
     def _save_state(self) -> None:
@@ -215,10 +240,12 @@ class ChaosInjector:
         """Fire every due, unfired, this-host fault, in spec order (two
         faults due at one step fire in list order — the ordering the
         demo's corrupt-then-kill sequence depends on)."""
+        self._last_step = int(step)
         for fault_id, fault in enumerate(self.faults):
             if (not self._mine(fault) or self._fired(fault_id)
                     or step < int(fault["step"])
-                    or fault["kind"] == "save_io_flake"):
+                    # hook-driven faults fire from their own seams
+                    or fault["kind"] in ("save_io_flake", "comm_stall")):
                 continue
             getattr(self, f"_fire_{fault['kind']}")(fault_id, fault, step)
 
@@ -361,3 +388,53 @@ class ChaosInjector:
             raise OSError(
                 f"chaos: injected save IO failure (fault #{fault_id}, "
                 f"{remaining - 1} more to come)")
+
+    # -- ring hop seam -----------------------------------------------------
+
+    def comm_stall_hook(self, axis: str, hop: int) -> None:
+        """The hop monitor's ``fault_hook`` (the ring hop seam,
+        ``parallel/collectives.py``): sleep ``delay_s`` inside the
+        collective for a ``comm_stall`` fault's first N hops at/after
+        its trigger step. Runs AFTER the monitor's health write, so the
+        stalled collective is already named ``in_flight`` on disk when
+        the watchdog fires. The remaining-hop count persists in the
+        chaos state file, so a resumed incarnation doesn't stall again
+        (fire-once per logical run, like every other fault)."""
+        for fault_id, fault in enumerate(self.faults):
+            if fault["kind"] != "comm_stall" or not self._mine(fault):
+                continue
+            want_axis = fault.get("axis")
+            if want_axis is not None and want_axis != axis:
+                continue
+            # during step N the loop's last on_step was N-1, so the
+            # fault for trigger step S is due once _last_step >= S - 1
+            last = -1 if self._last_step is None else self._last_step
+            if last < int(fault["step"]) - 1:
+                continue
+            key = str(fault_id)
+            remaining = self._state["stall_remaining"].get(
+                key, int(fault.get("hops", 1)))
+            if remaining <= 0:
+                continue
+            self._state["stall_remaining"][key] = remaining - 1
+            if remaining - 1 <= 0 and not self._fired(fault_id):
+                self._state["fired"].append(fault_id)
+            self._save_state()
+            delay = float(fault.get("delay_s", 30.0))
+            self.telemetry.count("chaos/faults")
+            self.telemetry.instant(
+                "chaos_fault", kind="comm_stall", fault_id=fault_id,
+                trigger_step=fault["step"], axis=axis, hop=hop,
+                delay_s=delay, remaining=remaining - 1)
+            log.warning(
+                "chaos: comm_stall fault #%d stalling axis %s hop %d "
+                "for %.1fs (%d more hop(s) to stall)",
+                fault_id, axis, hop, delay, remaining - 1)
+            time.sleep(delay)
+
+    def wants_comm_stall(self) -> bool:
+        """True when this host's share of the spec includes a
+        ``comm_stall`` — the Trainer refuses such a spec unless the
+        comms hop monitor (its seam) is on."""
+        return any(f["kind"] == "comm_stall" and self._mine(f)
+                   for f in self.faults)

@@ -71,6 +71,15 @@ Subcommands:
   artifact family, REG-rule drift detection over per-(metric × config
   × chip) series, and entry-vs-entry diffs with the exact ``bench
   compare`` gating semantics (docs/registry.md).
+- ``tpu-ddp comms bench|calibrate|exposure|forensics`` — the comms
+  observatory: measure collective microbenchmarks over the real local
+  mesh and fit the per-link α-β interconnect model (schema-versioned
+  artifact; registry kind "comms", ``bench compare`` gates achieved
+  bandwidth), assemble the per-chip calibrated model (``tune
+  --comms-from`` consumes it), measure a recorded run's exposed
+  (non-overlapped) comm share against its comm-stripped twin, and name
+  a hung run's suspect collective against the program-order schedule
+  (docs/comms.md).
 - ``tpu-ddp tune`` — roofline-guided auto-tuner: enumerates parallelism
   strategy × mesh shape × ``--zero1``/``--grad-compress`` overlays ×
   batch × ``steps_per_call``, compiles every candidate devicelessly,
@@ -192,6 +201,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_ddp.tuner.cli import main as tune_main
 
         return tune_main(argv[1:])
+    # comms owns its argparse surface; bench/exposure/forensics compile
+    # real programs (lazy jax), calibrate stays stdlib-only
+    if argv[:1] == ["comms"]:
+        from tpu_ddp.comms.cli import main as comms_main
+
+        return comms_main(argv[1:])
     if argv[:2] == ["bench", "compare"]:
         from tpu_ddp.analysis.regress import main as compare_main
 
@@ -273,6 +288,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="static step anatomy + roofline + collective fingerprint, "
              "optionally joined with a run dir's telemetry "
              "(tpu-ddp analyze --help)",
+    )
+    sub.add_parser(
+        "comms",
+        help="comms observatory: measured collective microbenchmarks + "
+             "alpha-beta link calibration, exposed-comm attribution, "
+             "stuck-collective forensics (tpu-ddp comms --help)",
     )
     sub.add_parser(
         "tune",

@@ -270,6 +270,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "hosts, seeded and fire-once per logical run "
                         "(state in --telemetry-dir) — the elastic "
                         "runtime's CI harness (docs/resilience.md)")
+    p.add_argument("--comms-monitor", action="store_true",
+                   help="instrument the quantized ring collectives with "
+                        "a per-hop host callback: live per-axis achieved "
+                        "bandwidth + the in-flight collective land in "
+                        "comms-health-p<host>.json (under "
+                        "--telemetry-dir), and a watchdog hang writes a "
+                        "forensics bundle naming the suspect collective "
+                        "(docs/comms.md). Changes the traced program, so "
+                        "it refuses --lint-on-start")
     p.add_argument("--health", choices=["off", "on"], default="off",
                    help="numerics flight recorder: global grad/param/"
                         "update norms + NaN/Inf sentinels computed INSIDE "
@@ -475,6 +484,7 @@ def config_from_args(args) -> TrainConfig:
         watchdog_deadline_seconds=args.watchdog_deadline,
         watchdog_abort=args.watchdog_abort,
         chaos_spec=args.chaos,
+        comms_monitor=args.comms_monitor,
         health=args.health,
         health_policy=args.health_policy,
         health_per_layer_stride=args.health_per_layer_stride,

@@ -1,0 +1,29 @@
+"""Comms observatory: measured collective cost, not spec-sheet faith.
+
+Every other observability axis in the framework measures what it claims
+(steps, memory, curves); this package closes the last gap — interconnect
+cost. Four legs, one artifact:
+
+- ``microbench``: sweep real collectives (the fingerprint vocabulary plus
+  the quantized ring from ``parallel/collectives.py``) over every real
+  mesh axis and payload sizes, measuring achieved bandwidth + latency;
+- ``model``: fit per-(chip, axis, kind, dtype) α-β link models from the
+  sweeps and assemble them from evidence files / the registry, exactly
+  the way ``tuner/calibrate.py`` assembles HBM evidence;
+- ``exposure``: measure the NON-overlapped comm share of a recorded run's
+  step by timing the recorded program against its comm-stripped twin;
+- ``forensics``: name the suspect in-flight collective when the watchdog
+  declares a hang, off the ring hop-hook's health files.
+
+CLI: ``tpu-ddp comms bench|calibrate|exposure|forensics`` (docs/comms.md).
+"""
+
+from tpu_ddp.comms.model import (  # noqa: F401
+    COMMS_SCHEMA_VERSION,
+    AlphaBeta,
+    LinkModel,
+    comms_model_for_chip,
+    fit_alpha_beta,
+    link_key,
+    model_from_comms_record,
+)

@@ -1,0 +1,170 @@
+"""Exposed-comm attribution: measure the NON-overlapped comm share.
+
+The roofline's ``comm_share_of_step`` is a model (static wire bytes over
+link bandwidth); XLA's latency-hiding scheduler may overlap most of it
+behind compute. This module measures what actually stayed exposed: time
+the recorded program (rebuilt through the shared ``build_abstract_step``
+/ compile-cache path ``tpu-ddp analyze`` itself uses) against its
+COMM-STRIPPED TWIN — the same config on a 1-device mesh, where every
+collective degenerates to a no-op but the per-device compute is
+identical. The difference is the step time the collectives could not
+hide:
+
+    exposed_comm_s      = max(0, t_full - t_stripped)
+    measured_comm_share = exposed_comm_s / t_full
+
+dp-family only (dp, +zero1, +grad-compress): those strategies replicate
+compute, so the 1-device twin really is compute-identical. Model/
+sequence/pipeline sharding changes per-device compute with the mesh —
+a twin there would mis-attribute, so this refuses by name.
+
+The record lands in ``<run_dir>/comms-exposure.json`` where ``tpu-ddp
+analyze`` and ``trace summarize`` join it as measured-vs-modeled comm
+share (docs/comms.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+COMMS_EXPOSURE_SCHEMA_VERSION = 1
+
+#: the run-dir filename the analyze/summarize joins look for
+EXPOSURE_FILENAME = "comms-exposure.json"
+
+#: strategies whose 1-device twin is compute-identical (replicated
+#: compute; collectives are pure overhead)
+_DP_FAMILY = ("dp",)
+
+
+def _materialize(tree):
+    """Concrete zero arrays for an abstract (ShapeDtypeStruct) tree."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), tree)
+
+
+def _time_program(meta: dict, devices, reps: int) -> float:
+    """Median-free min-of-reps wall time of one optimizer step of the
+    recorded program, executed for real on ``devices``. The step
+    donates its state, so each rep feeds the previous output forward
+    (steady-state timing, no donation faults)."""
+    import jax
+
+    from tpu_ddp.analysis.explain import _run_meta_program, abstract_batch
+
+    step, state_abs, mesh, _key, cfg = _run_meta_program(meta, devices)
+    state = _materialize(state_abs)
+    batch = _materialize(abstract_batch(mesh, cfg.per_shard_batch, 32))
+    out = step(state, batch)  # compile + warm; donates `state`
+    jax.block_until_ready(out)
+    state = out[0]
+    best = float("inf")
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        out = step(state, batch)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+        state = out[0]
+    return best
+
+
+def measure_exposure(run_dir: str, *, devices=None, reps: int = 10) -> dict:
+    """Measure the run's exposed comm share; raises ``ValueError`` with
+    a pointed reason for runs the twin method cannot attribute (non-dp
+    strategy, mesh larger than the local devices, pre-header traces)."""
+    import jax
+
+    from tpu_ddp.analysis.explain import (
+        measured_phases,
+        read_run_meta,
+        run_strategy_label,
+    )
+
+    meta = read_run_meta(run_dir)
+    parallelism = meta.get("strategy", "dp")
+    if parallelism not in _DP_FAMILY:
+        raise ValueError(
+            f"exposure twin needs replicated compute; {parallelism!r} "
+            "shards compute with the mesh, so its 1-device twin would "
+            "mis-attribute model/pipeline compute as comm (dp-family "
+            "runs only)"
+        )
+    mesh_shape = {a: int(s) for a, s in (meta.get("mesh") or {}).items()}
+    n_needed = 1
+    for s in mesh_shape.values():
+        n_needed *= s
+    devices = list(devices if devices is not None else jax.devices())
+    if n_needed > len(devices):
+        raise ValueError(
+            f"run trained on {n_needed} devices; only {len(devices)} "
+            "visible here — re-run where the mesh fits"
+        )
+    if n_needed < 2:
+        raise ValueError(
+            "run trained on a single device: there is no comm to expose")
+    t_full = _time_program(meta, devices[:n_needed], reps)
+    twin_meta = dict(meta)
+    twin_meta["mesh"] = {"data": 1}
+    # the twin strips the whole comm PATH, not just the wire hops: the
+    # quantized ring's pack/unpack and zero1's shard bookkeeping exist
+    # only to serve the exchange, so their cost belongs to exposed comm
+    # (and a size-1 ring cannot even build — shard_map's replication
+    # check has no hops to infer it from)
+    twin_cfg = dict(meta.get("config") or {})
+    twin_cfg["grad_compress"] = "none"
+    twin_cfg["grad_compress_error_feedback"] = False
+    twin_cfg["zero1"] = False
+    twin_meta["config"] = twin_cfg
+    t_stripped = _time_program(twin_meta, devices[:1], reps)
+    exposed = max(0.0, t_full - t_stripped)
+    try:
+        phases = measured_phases(run_dir)
+        step_rec = phases.get("compiled_step", {})
+        telemetry_step = step_rec.get("per_step_p50_s") \
+            or step_rec.get("p50_s")
+    except Exception:
+        telemetry_step = None
+    return {
+        "comms_exposure_schema_version": COMMS_EXPOSURE_SCHEMA_VERSION,
+        "run_id": meta.get("run_id"),
+        "strategy": run_strategy_label(meta),
+        "mesh": mesh_shape,
+        "n_devices": n_needed,
+        "device_kind": str(devices[0].device_kind),
+        "reps": reps,
+        "t_full_s": t_full,
+        "t_stripped_s": t_stripped,
+        "exposed_comm_s": exposed,
+        "measured_comm_share": (exposed / t_full) if t_full > 0 else None,
+        "telemetry_step_p50_s": telemetry_step,
+    }
+
+
+def write_exposure(run_dir: str, rec: dict) -> str:
+    """Atomically land the record where the joins look for it."""
+    path = os.path.join(run_dir, EXPOSURE_FILENAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def read_exposure(run_dir: str) -> Optional[dict]:
+    """The run's exposure record, or None — stdlib-only so the analyze/
+    summarize joins can call it without loading jax."""
+    path = os.path.join(run_dir, EXPOSURE_FILENAME)
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(rec, dict) \
+            or "comms_exposure_schema_version" not in rec:
+        return None
+    return rec

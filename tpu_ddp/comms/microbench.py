@@ -1,0 +1,316 @@
+"""Measured collective microbenchmarks over the real mesh.
+
+For every collective kind in the lint/analyze fingerprint vocabulary
+(``analysis/hlo.py::COLLECTIVE_OPS``) plus the explicit quantized rings
+from ``parallel/collectives.py``, sweep payload sizes over each
+nontrivial mesh axis and measure wall time (min over reps, after a
+compile+warmup call). Wire bytes per invocation use the SAME ring
+factors the static anatomy uses (``analysis/hlo.py::_wire_bytes``:
+all-reduce 2(g-1)/g, AG/RS/A2A (g-1)/g, permute 1x; the explicit rings
+use ``chunk_wire_bytes`` per hop), so measured achieved bandwidth and
+the accounted bytes-on-wire numbers are directly comparable.
+
+The sweeps fit into per-link α-β lines (``comms/model.py``) and are
+emitted as a schema-versioned artifact (``bench_artifact``) that
+``registry record`` classifies as kind ``"comms"`` and ``bench
+compare`` gates — achieved bandwidth is the higher-is-better key.
+
+Everything runs on CPU virtual devices exactly as on TPU (explicit
+collectives, shard_map); only the numbers differ.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_ddp.comms.model import (
+    COMMS_SCHEMA_VERSION,
+    AlphaBeta,
+    fit_alpha_beta,
+    link_key,
+)
+
+#: fingerprint-vocabulary kinds benched via the stock lax collectives
+BENCH_KINDS = ("all-reduce", "reduce-scatter", "all-gather",
+               "all-to-all", "collective-permute")
+
+#: wire dtypes swept for the stock kinds (HLO dtype tokens)
+BENCH_DTYPES = ("f32", "bf16", "s8")
+
+#: the explicit compressed rings (whole-op: N-1 quantized hops [+ the
+#: all-gather phase]), keyed by their WIRE dtype — in HLO these lower to
+#: collective-permute/all-gather, so they carry their own kind names
+RING_KINDS = ("ring-all-reduce", "ring-reduce-scatter")
+
+#: ring wire modes -> HLO wire dtype token
+RING_MODE_DTYPE = {"f32": "f32", "bf16": "bf16", "int8": "s8"}
+
+#: per-shard payload sizes (elements) — divisible by any axis size up to
+#: 16 and by the default int8 block (256)
+DEFAULT_SIZES = (4096, 16384, 65536, 262144)
+DEFAULT_REPS = 10
+
+
+def _np_dtype(tok: str):
+    import jax.numpy as jnp
+
+    return {"f32": jnp.float32, "bf16": jnp.bfloat16, "s8": jnp.int8}[tok]
+
+
+def _shard_fn(kind: str, axis: str):
+    """The per-shard collective body and its output PartitionSpec."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_ddp.parallel.collectives import ring_shift
+
+    if kind == "all-reduce":
+        return (lambda x: lax.psum(x, axis)), P()
+    if kind == "reduce-scatter":
+        return (lambda x: lax.psum_scatter(
+            x, axis, scatter_dimension=0, tiled=True)), P(axis)
+    if kind == "all-gather":
+        return (lambda x: lax.all_gather(x, axis, tiled=True)), P()
+    if kind == "all-to-all":
+        return (lambda x: lax.all_to_all(
+            x, axis, split_axis=0, concat_axis=0, tiled=True)), P(axis)
+    if kind == "collective-permute":
+        return (lambda x: ring_shift(x, axis, 1)), P(axis)
+    raise ValueError(f"unknown bench kind {kind!r}")
+
+
+def _ring_fn(kind: str, axis: str, mode: str, block: int):
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_ddp.parallel.collectives import (
+        ring_all_reduce,
+        ring_reduce_scatter,
+    )
+
+    if kind == "ring-all-reduce":
+        return (lambda x: ring_all_reduce(
+            x, axis, mode=mode, block=block)[0]), P()
+    if kind == "ring-reduce-scatter":
+        return (lambda x: ring_reduce_scatter(
+            x, axis, mode=mode, block=block)[0]), P(axis)
+    raise ValueError(f"unknown ring kind {kind!r}")
+
+
+def _jit_sharded(mesh, axis: str, body, out_spec):
+    """One jit wrapper per collective body, built OUTSIDE the sweep
+    loops (the factory idiom RCP001 asks for) — jit caches per input
+    shape, so a single wrapper serves every payload size."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=P(axis), out_specs=out_spec))
+
+
+def _time_best(fn, x, reps: int) -> float:
+    import jax
+
+    jax.block_until_ready(fn(x))  # compile + warm the dispatch path
+    best = float("inf")
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _global_input(mesh, axis: str, size: int, dtype_tok: str):
+    """A (g*size,) global array sharded over ``axis`` — each shard holds
+    the ``size``-element per-device payload."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    g = mesh.shape[axis]
+    if dtype_tok == "s8":
+        arr = jnp.ones((g * size,), dtype=_np_dtype(dtype_tok))
+    else:
+        arr = (jnp.arange(g * size, dtype=jnp.float32) % 251.0
+               ).astype(_np_dtype(dtype_tok))
+    return jax.device_put(arr, NamedSharding(mesh, P(axis)))
+
+
+def _ring_wire_bytes(kind: str, size: int, g: int, mode: str,
+                     block: int) -> int:
+    """Per-device bytes-on-wire for one whole explicit-ring invocation,
+    from the compressor's own static accounting."""
+    from tpu_ddp.analysis.hlo import _wire_bytes
+    from tpu_ddp.parallel.compression import chunk_wire_bytes
+
+    if g <= 1:
+        return 0
+    cw = chunk_wire_bytes(size // g, mode, block)
+    hops = (g - 1) * cw  # reduce-scatter phase: N-1 quantized hops
+    if kind == "ring-reduce-scatter":
+        return hops
+    return hops + _wire_bytes("all-gather", cw, g)  # + gather phase
+
+
+def nontrivial_axes(mesh) -> Dict[str, int]:
+    return {a: int(s) for a, s in
+            zip(mesh.axis_names, mesh.devices.shape) if s > 1}
+
+
+def run_sweeps(
+    mesh,
+    *,
+    kinds: Sequence[str] = BENCH_KINDS + RING_KINDS,
+    dtypes: Sequence[str] = BENCH_DTYPES,
+    ring_modes: Sequence[str] = ("f32", "bf16", "int8"),
+    sizes: Sequence[int] = DEFAULT_SIZES,
+    reps: int = DEFAULT_REPS,
+    block: int = 256,
+    progress=None,
+) -> Tuple[List[dict], List[dict]]:
+    """Measure every (kind, dtype, axis, size) combination; returns
+    ``(sweeps, skipped)``. A combination that fails to build or run is
+    recorded in ``skipped`` with the error, never fatal — int8 support
+    varies by op and backend."""
+    from tpu_ddp.analysis.hlo import _wire_bytes
+
+    sweeps: List[dict] = []
+    skipped: List[dict] = []
+    axes = nontrivial_axes(mesh)
+    for axis, g in sorted(axes.items()):
+        combos: List[Tuple[str, str, object]] = []
+        for kind in kinds:
+            if kind in RING_KINDS:
+                continue  # rings are driven by ring_modes below
+            for tok in dtypes:
+                body, out_spec = _shard_fn(kind, axis)
+                combos.append((kind, tok, (body, out_spec, tok)))
+        for kind in (k for k in kinds if k in RING_KINDS):
+            for mode in ring_modes:
+                body, out_spec = _ring_fn(kind, axis, mode, block)
+                combos.append(
+                    (kind, RING_MODE_DTYPE[mode],
+                     (body, out_spec, "f32", mode)))
+        for kind, tok, built in combos:
+            body, out_spec, in_tok = built[0], built[1], built[2]
+            mode = built[3] if len(built) > 3 else None
+            fn = None  # built once per combo, reused across sizes
+            for size in sizes:
+                if size % g:
+                    skipped.append({
+                        "kind": kind, "dtype": tok, "axis": axis,
+                        "size": size,
+                        "error": f"size not divisible by axis size {g}",
+                    })
+                    continue
+                try:
+                    if fn is None:
+                        fn = _jit_sharded(mesh, axis, body, out_spec)
+                    x = _global_input(mesh, axis, size, in_tok)
+                    t = _time_best(fn, x, reps)
+                except Exception as e:
+                    skipped.append({
+                        "kind": kind, "dtype": tok, "axis": axis,
+                        "size": size,
+                        "error": f"{type(e).__name__}: {e}",
+                    })
+                    continue
+                width = 1 if tok == "s8" else (2 if tok == "bf16" else 4)
+                if mode is not None:
+                    wire = _ring_wire_bytes(kind, size, g, mode, block)
+                    payload = size * 4  # ring input is f32
+                else:
+                    payload = size * width
+                    wire = _wire_bytes(kind, payload, g)
+                sweeps.append({
+                    "kind": kind, "dtype": tok, "axis": axis,
+                    "group_size": g, "size": size,
+                    "payload_bytes": payload, "wire_bytes": wire,
+                    "time_s": t,
+                    "bw_bytes_per_s": (wire / t) if t > 0 and wire else 0.0,
+                })
+                if progress:
+                    progress(sweeps[-1])
+    return sweeps, skipped
+
+
+def fit_links(sweeps: Sequence[dict]) -> Dict[str, AlphaBeta]:
+    """Per-link α-β fits over the sweep points; links with fewer than
+    two distinct wire sizes are dropped (no line through one point)."""
+    grouped: Dict[str, List[dict]] = {}
+    for row in sweeps:
+        key = link_key(row["kind"], row["dtype"], row["axis"])
+        grouped.setdefault(key, []).append(row)
+    out: Dict[str, AlphaBeta] = {}
+    for key, rows in grouped.items():
+        xs = [r["wire_bytes"] for r in rows]
+        ys = [r["time_s"] for r in rows]
+        if len(set(xs)) < 2:
+            continue
+        out[key] = fit_alpha_beta(xs, ys)
+    return out
+
+
+def bench_artifact(mesh, sweeps: Sequence[dict], skipped: Sequence[dict],
+                   *, reps: int = DEFAULT_REPS) -> dict:
+    """The schema-versioned ``comms bench --json`` artifact. Headline
+    keys gate in ``bench compare`` (achieved bandwidth: quality,
+    higher-better; α: unit-scale size); per-link ``rows`` trend through
+    the registry's measured channel."""
+    import statistics
+
+    import jax
+
+    from tpu_ddp.comms.model import _chip_key
+    from tpu_ddp.telemetry.provenance import artifact_provenance
+
+    devices = mesh.devices.reshape(-1)
+    device_kind = str(devices[0].device_kind)
+    chip = _chip_key(device_kind) or device_kind
+    mesh_shape = {a: int(s) for a, s in
+                  zip(mesh.axis_names, mesh.devices.shape)}
+    fitted = fit_links(sweeps)
+    best_bw: Dict[str, float] = {}
+    group_of: Dict[str, int] = {}
+    for row in sweeps:
+        key = link_key(row["kind"], row["dtype"], row["axis"])
+        best_bw[key] = max(best_bw.get(key, 0.0), row["bw_bytes_per_s"])
+        group_of[key] = row["group_size"]
+    links = {
+        key: {
+            **ab.to_json(),
+            "achieved_bw_bytes_per_s": best_bw.get(key, 0.0),
+            "group_size": group_of.get(key, 0),
+        }
+        for key, ab in sorted(fitted.items())
+    }
+    comms = {
+        "chip": chip,
+        "device_kind": device_kind,
+        "n_devices": int(devices.size),
+        "mesh": mesh_shape,
+        "reps": reps,
+        # headline gates: the best measured link bandwidth (quality,
+        # higher is better) and the median fitted latency (unit size)
+        "achieved_bw_bytes_per_s": max(best_bw.values()) if best_bw else 0.0,
+        "alpha_s": (statistics.median(ab.alpha_s
+                                      for ab in fitted.values())
+                    if fitted else None),
+        "links": links,
+        # registry trend channel: one measured row per link
+        "rows": {key: {"value": bw} for key, bw in sorted(best_bw.items())},
+        "sweeps": list(sweeps),
+        "skipped": list(skipped),
+    }
+    return {
+        "type": "comms",
+        "comms_schema_version": COMMS_SCHEMA_VERSION,
+        "provenance": artifact_provenance(
+            descriptor={"artifact": "comms_bench", "chip": chip,
+                        "mesh": mesh_shape,
+                        "n_devices": int(devices.size)},
+            device_kind=device_kind, jax_version=jax.__version__,
+            mesh=mesh_shape,
+        ),
+        "comms": comms,
+    }

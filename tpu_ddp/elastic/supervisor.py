@@ -257,6 +257,22 @@ class Supervisor:
             exit_class = classify_exit(self.run_dir, prior)
             if exit_class is None:
                 exit_class = "spawn_failure" if rc != 0 else "clean"
+            # a hang death carries its stuck-collective evidence when the
+            # child ran with --comms-monitor: the forensics bundle (or the
+            # raw health files) name the ring that wedged — the decision
+            # log is where the operator reads WHY this restart happened
+            suspect = None
+            if exit_class == "hang":
+                from tpu_ddp.comms.forensics import suspect_from_files
+
+                try:
+                    suspect = suspect_from_files(self.run_dir)
+                except Exception:
+                    suspect = None
+                if suspect:
+                    print(f"[elastic] hang forensics: suspect collective "
+                          f"{suspect.get('key')} "
+                          f"({suspect.get('source')})", flush=True)
             if exit_class == "clean" and rc == 0:
                 append_decision(self.run_dir, {
                     "event": "exit",
@@ -279,6 +295,7 @@ class Supervisor:
                     "event": "stop",
                     "incarnation": incarnation,
                     "exit_class": exit_class,
+                    "suspect_collective": suspect,
                     "action": "stop",
                     "attempt": decision.attempt,
                     "reason": decision.reason,
@@ -362,6 +379,7 @@ class Supervisor:
                 "event": "restart",
                 "incarnation": incarnation,
                 "exit_class": exit_class,
+                "suspect_collective": suspect,
                 "action": "restart",
                 "attempt": decision.attempt,
                 "backoff_s": round(decision.backoff_s, 3),

@@ -104,6 +104,19 @@ class MonitorConfig:
     max_auto_profiles: int = 3             # capture_profile action: alert-
                                            # armed profiler captures per run
                                            # (edge-triggered; 0 disables)
+    comms_baseline: Optional[str] = None   # COM001: path to a `comms
+                                           # bench --json` artifact — the
+                                           # calibrated per-axis bandwidth
+                                           # the live comms-health files
+                                           # are judged against (None
+                                           # disables the rule; it only
+                                           # fires where a run was started
+                                           # with --comms-monitor)
+    comms_collapse_frac: float = 0.25      # COM001: a host axis's
+                                           # staleness-adjusted measured
+                                           # bandwidth below this fraction
+                                           # of its calibrated baseline
+                                           # fires
 
     def validate(self) -> "MonitorConfig":
         if self.window < 8:
@@ -135,6 +148,10 @@ class MonitorConfig:
             raise ValueError(
                 f"max_auto_profiles must be >= 0, got "
                 f"{self.max_auto_profiles}")
+        if not 0.0 < self.comms_collapse_frac <= 1.0:
+            raise ValueError(
+                f"comms_collapse_frac must be in (0, 1], got "
+                f"{self.comms_collapse_frac}")
         return self
 
 
@@ -234,6 +251,7 @@ class HostSnapshot:
     ended: bool = False   # clean shutdown (run_end marker): never "lost"
     health: Dict[str, object] = dataclasses.field(default_factory=dict)
     memory: Dict[str, object] = dataclasses.field(default_factory=dict)
+    comms: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -402,6 +420,54 @@ def _heartbeat_files(run_dir: str) -> Dict[int, str]:
     return _per_host(run_dir, "heartbeat-p*.json")
 
 
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def comms_host_view(rec: Optional[dict],
+                    now: float) -> Dict[str, object]:
+    """One host's ``comms-health-p<i>.json`` record (the hop monitor's
+    live file, docs/comms.md) folded for the snapshot. The per-axis
+    measured bandwidth is STALENESS-ADJUSTED while a collective is in
+    flight: a wedged ring stops landing hops, so the last written
+    bandwidth would stay flattering forever — charging the silent
+    seconds since the last write to the open measurement window makes
+    the figure decay toward zero while the hang persists, which is
+    exactly the COM001 signal."""
+    if not isinstance(rec, dict):
+        return {}
+    upd = rec.get("updated_unix")
+    age = (max(now - upd, 0.0)
+           if isinstance(upd, (int, float)) else None)
+    n_dev = rec.get("n_devices")
+    n_dev = int(n_dev) if isinstance(n_dev, int) and n_dev >= 1 else 1
+    in_flight = rec.get("in_flight")
+    bytes_win = rec.get("axis_bytes_window") or {}
+    span = rec.get("window_span_s") or {}
+    axis_bw: Dict[str, float] = {}
+    for axis, bw in (rec.get("axis_bw") or {}).items():
+        if not isinstance(bw, (int, float)):
+            continue
+        eff = float(bw)
+        b, s = bytes_win.get(axis), span.get(axis)
+        if (in_flight and age and isinstance(b, (int, float))
+                and isinstance(s, (int, float))):
+            eff = float(b) / ((float(s) + age) * n_dev)
+        axis_bw[axis] = eff
+    return {
+        "axis_bw": axis_bw,
+        "in_flight": in_flight,
+        "last_collective": rec.get("last_collective"),
+        "step": rec.get("step"),
+        "age_s": age,
+    }
+
+
 def _per_host(run_dir: str, pattern: str) -> Dict[int, str]:
     """{process_index: path} for a per-host file family in a run dir.
 
@@ -473,6 +539,13 @@ class FleetAggregator:
             if rec:
                 heartbeats[pid] = rec
                 self._host(pid)  # a heartbeat alone makes the host exist
+        comms_views: Dict[int, Dict[str, object]] = {}
+        for pid, path in _per_host(
+                self.run_dir, "comms-health-p*.json").items():
+            view = comms_host_view(_read_json(path), now)
+            if view:
+                comms_views[pid] = view
+                self._host(pid)  # so is a comms-health file
 
         cfg = self.config
         hosts: List[HostSnapshot] = []
@@ -537,6 +610,10 @@ class FleetAggregator:
                     )
                     if isinstance(st.gauges.get(gauge), (int, float))
                 },
+                # the hop monitor's live per-axis achieved bandwidth
+                # (staleness-adjusted, docs/comms.md) — COM001's input;
+                # empty unless the run was started with --comms-monitor
+                comms=comms_views.get(pid, {}),
             ))
 
         for phase in ("compiled_step", "data_wait"):

@@ -114,6 +114,18 @@ ALERT_RULES: Dict[str, Dict[str, str]] = {
                "shrink the batch, enable --remat/--zero1, or re-run "
                "`tpu-ddp tune` under the measured cap (docs/memory.md)",
     },
+    "COM001": {
+        "title": "interconnect bandwidth collapse",
+        "severity": "warning",
+        "kind": "threshold",
+        "fix": "a host axis's live measured collective bandwidth "
+               "(staleness-adjusted from comms-health-p<i>.json) fell "
+               "below the collapse fraction of its calibrated baseline "
+               "(`tpu-ddp comms bench`): check the in-flight collective "
+               "named in the message and the ICI/DCN path under it; if "
+               "the ring is fully wedged the watchdog's hang bundle "
+               "will name the suspect collective (docs/comms.md)",
+    },
     "TRN001": {
         "title": "loss plateau",
         "severity": "warning",
@@ -201,6 +213,26 @@ class AlertEngine:
         self._straggler_runs: Dict[int, int] = {}
         self._rate_baseline: deque = deque(
             maxlen=max(self.config.baseline_polls, 3))
+        # COM001's calibrated per-axis bandwidth reference, loaded once
+        # from the configured `comms bench --json` artifact ({} = rule
+        # disabled: no baseline, or an unreadable/baseline-less file —
+        # the engine must keep watching either way)
+        self._comms_baselines: Dict[str, float] = {}
+        if self.config.comms_baseline:
+            try:
+                with open(self.config.comms_baseline) as f:
+                    art = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                log.warning(
+                    "COM001 disabled: could not read the comms baseline "
+                    "artifact at %r", self.config.comms_baseline)
+                art = None
+            if isinstance(art, dict):
+                from tpu_ddp.comms.model import axis_baselines
+
+                self._comms_baselines = axis_baselines(
+                    art.get("comms") if isinstance(art.get("comms"), dict)
+                    else art)
 
     # -- rule evaluation --------------------------------------------------
 
@@ -284,6 +316,35 @@ class AlertEngine:
                     + ") — `tpu-ddp mem` has the breakdown",
                     float(frac),
                 )
+
+            # COM001: live measured per-axis collective bandwidth (the
+            # hop monitor's health file, staleness-adjusted by the
+            # aggregator) against the calibrated baseline. Worst
+            # offending axis names the message; the in-flight collective
+            # rides along — it is the hang forensics' suspect.
+            if self._comms_baselines and h.comms:
+                worst = None  # (axis, eff, base)
+                for axis, eff in (h.comms.get("axis_bw") or {}).items():
+                    base = self._comms_baselines.get(axis)
+                    if (base and isinstance(eff, (int, float))
+                            and eff < cfg.comms_collapse_frac * base
+                            and (worst is None
+                                 or eff / base < worst[1] / worst[2])):
+                        worst = (axis, float(eff), base)
+                if worst is not None:
+                    axis, eff, base = worst
+                    flight = h.comms.get("in_flight") or {}
+                    stuck = (f"; in flight: {flight.get('key')} "
+                             f"hop {flight.get('hop')}/"
+                             f"{flight.get('n_hops')}"
+                             if flight.get("key") else "")
+                    found[("COM001", h.host)] = (
+                        f"host {h.host} axis {axis!r} measured "
+                        f"{eff:.3g} B/s vs calibrated {base:.3g} B/s "
+                        f"(< {cfg.comms_collapse_frac:.0%})"
+                        + stuck,
+                        eff,
+                    )
 
             # latched, not edge-on-delta: NaNs never un-happen, so the
             # alert must stay in the active set (and never emit a bogus

@@ -348,6 +348,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="MEM001 fires when a host's measured HBM "
                          "high-water exceeds this fraction of the "
                          "device limit (0 disables; docs/memory.md)")
+    ap.add_argument("--comms-baseline", default=None, metavar="FILE",
+                    help="`tpu-ddp comms bench --json` artifact: COM001 "
+                         "fires when a host axis's live measured "
+                         "collective bandwidth (comms-health-p<i>.json, "
+                         "staleness-adjusted) falls below "
+                         "--comms-collapse-frac of its calibrated "
+                         "per-axis baseline (docs/comms.md; needs a run "
+                         "started with --comms-monitor)")
+    ap.add_argument("--comms-collapse-frac", type=float, default=0.25,
+                    metavar="FRACTION",
+                    help="COM001 threshold as a fraction of the "
+                         "calibrated baseline bandwidth")
     ap.add_argument("--webhook", default=None, metavar="URL",
                     help="also POST every alert edge as JSON here")
     ap.add_argument("--no-alerts-file", action="store_true",
@@ -381,6 +393,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         mem_limit_frac=args.mem_limit_frac,
         webhook_url=args.webhook,
         max_auto_profiles=args.max_auto_profiles,
+        comms_baseline=args.comms_baseline,
+        comms_collapse_frac=args.comms_collapse_frac,
     )
     actions = ["log"] if args.json else []
     if not args.no_alerts_file:

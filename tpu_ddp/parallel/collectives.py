@@ -13,11 +13,57 @@ the axis bound.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 
 import tpu_ddp.compat  # noqa: F401  (jax.shard_map/typeof shims)
 import jax.numpy as jnp
 from jax import lax
+
+# ---- ring hop hook (the comms-observatory / chaos seam) ------------------
+#
+# When installed, every ring hop emits a host callback carrying the hop's
+# identity (kind / wire dtype / axis / hop index / wire bytes) plus a
+# traced probe scalar that forces data-dependent ordering. The gate is
+# checked at TRACE time: with no hook installed the traced program is
+# byte-identical to before (no custom-calls), so analyze/lint
+# fingerprints and the compile cache stay clean. Install BEFORE the step
+# compiles (the Trainer does this in __init__; an already-jitted step
+# keeps whatever the hook state was when it traced).
+
+_RING_HOP_HOOK = None
+
+#: ring wire mode -> the HLO dtype token the hop's payload carries
+_MODE_WIRE_DTYPE = {"f32": "f32", "bf16": "bf16", "int8": "s8"}
+
+
+def set_ring_hop_hook(hook):
+    """Install (or clear, with None) the process-wide ring hop hook:
+    ``hook(probe, *, kind, dtype, axis, hop, n_hops, wire_bytes)``,
+    called from ``jax.debug.callback`` once per device per hop. Returns
+    the previous hook (restore-on-exit idiom)."""
+    global _RING_HOP_HOOK
+    prev = _RING_HOP_HOOK
+    _RING_HOP_HOOK = hook
+    return prev
+
+
+def _dispatch_hop(probe, **info):
+    hook = _RING_HOP_HOOK  # read at CALL time: a cleared hook goes quiet
+    if hook is not None:
+        hook(probe, **info)
+
+
+def _emit_hop(probe, *, kind, mode, axis, hop, n_hops, wire_bytes):
+    """Trace the hop callback (only reached when a hook was installed at
+    trace time)."""
+    jax.debug.callback(
+        functools.partial(
+            _dispatch_hop, kind=kind,
+            dtype=_MODE_WIRE_DTYPE.get(mode, mode), axis=axis, hop=hop,
+            n_hops=n_hops, wire_bytes=int(wire_bytes)),
+        probe)
 
 
 def psum(x, axis: str):
@@ -57,7 +103,9 @@ def axis_size(axis: str):
 
 
 def ring_reduce_scatter(x, axis: str, *, mode: str = "f32",
-                        block: int = 256, with_error: bool = False):
+                        block: int = 256, with_error: bool = False,
+                        _hook_kind: str = "ring-reduce-scatter",
+                        _hook_total_hops: int = 0):
     """Ring reduce-scatter of a 1-D array built from ``ppermute``, with
     each hop's payload optionally quantized on the wire
     (``parallel/compression.py``) while accumulation stays f32 on-device.
@@ -113,6 +161,14 @@ def ring_reduce_scatter(x, axis: str, *, mode: str = "f32",
         payload = jax.tree.map(
             lambda t: lax.ppermute(t, axis, perm), payload)
         p = dequantize_chunk(payload, mode, block, s)
+        if _RING_HOP_HOOK is not None:
+            from tpu_ddp.parallel.compression import chunk_wire_bytes
+
+            _emit_hop(
+                p[0], kind=_hook_kind, mode=mode, axis=axis,
+                hop=step + 1,
+                n_hops=_hook_total_hops or (n - 1),
+                wire_bytes=chunk_wire_bytes(s, mode, block))
         p = p + jnp.take(chunks, (idx - 2 - step) % n, axis=0, mode="wrap")
     return p, err
 
@@ -139,7 +195,8 @@ def ring_all_reduce(x, axis: str, *, mode: str = "f32", block: int = 256,
         return x, (jnp.zeros_like(x) if with_error else None)
     s = x.shape[0] // n
     chunk, err = ring_reduce_scatter(
-        x, axis, mode=mode, block=block, with_error=with_error)
+        x, axis, mode=mode, block=block, with_error=with_error,
+        _hook_kind="ring-all-reduce", _hook_total_hops=n)
     payload = quantize_chunk(chunk, mode, block)
     if with_error and mode != "f32":
         e = chunk - dequantize_chunk(payload, mode, block, s)
@@ -152,7 +209,17 @@ def ring_all_reduce(x, axis: str, *, mode: str = "f32", block: int = 256,
             jax.tree.map(lambda t: t[i], gathered), mode, block, s)
         for i in range(n)
     ])
-    return rows.reshape(-1), err
+    out = rows.reshape(-1)
+    if _RING_HOP_HOOK is not None:
+        from tpu_ddp.parallel.compression import chunk_wire_bytes
+
+        # the all-gather phase is the ring's FINAL hop (hop n of n):
+        # each device receives the other n-1 quantized chunks
+        _emit_hop(
+            out[0], kind="ring-all-reduce", mode=mode, axis=axis,
+            hop=n, n_hops=n,
+            wire_bytes=(n - 1) * chunk_wire_bytes(s, mode, block))
+    return out, err
 
 
 def sync_gradients(grads, axis: str):

@@ -187,6 +187,12 @@ def _artifact_kind(art: dict) -> str:
         if art.get("topology"):
             return "aot"
         return "analyze_all"
+    if "comms_schema_version" in art or isinstance(
+            art.get("comms"), dict):
+        # `tpu-ddp comms bench --json`: the measured interconnect model
+        # (docs/comms.md) — must outrank the bare "rows" fallback below
+        # (the comms record carries a per-link rows trend channel too)
+        return "comms"
     if "images_per_sec_per_chip" in art or "vs_baseline" in art \
             or "rows" in art:
         return "bench"

@@ -240,19 +240,84 @@ def format_comms(counters: dict) -> List[str]:
     """The --grad-compress comms section: bytes-on-wire vs the
     uncompressed (f32-ring) equivalent and the effective ratio, from the
     ``comm/*`` counters the Trainer accumulates per step
-    (parallel/compression.py accounting). Empty when the run never
-    compressed a gradient collective."""
+    (parallel/compression.py accounting). These are ACCOUNTED numbers —
+    static wire-byte bookkeeping, not measurement (the measured
+    counterpart is :func:`format_comms_measured`). Empty when the run
+    never compressed a gradient collective."""
     wire = counters.get("comm/grad_bytes_on_wire")
     base = counters.get("comm/grad_bytes_uncompressed")
     if not wire:
         return []
     lines = [
-        "comms (gradient collectives):",
-        f"  bytes on wire        = {_human_bytes(wire)}",
+        "comms (gradient collectives, accounted):",
+        f"  bytes on wire        = {_human_bytes(wire)} (accounted)",
     ]
     if base:
-        lines.append(f"  uncompressed (f32)   = {_human_bytes(base)}")
+        lines.append(f"  uncompressed (f32)   = {_human_bytes(base)} "
+                     "(accounted)")
         lines.append(f"  compression ratio    = {base / wire:.2f}x")
+    return lines
+
+
+def comms_measured(path: str) -> dict:
+    """The run dir's MEASURED comms evidence (docs/comms.md): the
+    exposed-comm record ``tpu-ddp comms exposure`` landed and the hop
+    monitor's per-host health files (``--comms-monitor``). Stdlib-only;
+    empty dict when the target is a bare trace file or the run left no
+    comms evidence."""
+    out: dict = {}
+    if not os.path.isdir(path):
+        return out
+    from tpu_ddp.comms.exposure import read_exposure
+    from tpu_ddp.comms.forensics import read_health
+
+    exp = read_exposure(path)
+    if exp is not None:
+        out["exposure"] = exp
+    health = read_health(path)
+    if health:
+        out["health"] = health
+    return out
+
+
+def format_comms_measured(measured: dict) -> List[str]:
+    """The measured comms block: exposed (non-overlapped) comm share vs
+    the comm-stripped twin, plus each host's last-window achieved
+    per-axis wire bandwidth from the hop monitor. Empty when the run
+    left no measured comms evidence."""
+    lines: List[str] = []
+    exp = measured.get("exposure")
+    if isinstance(exp, dict):
+        lines.append("comms (measured):")
+        share = exp.get("measured_comm_share")
+        exposed = exp.get("exposed_comm_s")
+        if share is not None and isinstance(exposed, (int, float)):
+            lines.append(
+                f"  exposed comm share   = {share:.1%} of the step "
+                f"({exposed * 1e3:.2f} ms vs the comm-stripped twin)"
+            )
+        if isinstance(exp.get("t_full_s"), (int, float)):
+            lines.append(
+                f"  full / stripped step = {exp['t_full_s'] * 1e3:.2f} / "
+                f"{exp.get('t_stripped_s', 0) * 1e3:.2f} ms"
+            )
+    for h in measured.get("health") or []:
+        axis_bw = h.get("axis_bw") or {}
+        if axis_bw and not lines:
+            lines.append("comms (measured):")
+        for axis, bw in sorted(axis_bw.items()):
+            if isinstance(bw, (int, float)):
+                lines.append(
+                    f"  axis {axis:<14} = {_human_bytes(bw)}/s achieved "
+                    f"on wire (host {h.get('process_index', '?')}, "
+                    "hop-monitor window)"
+                )
+        last = h.get("last_collective")
+        if last:
+            lines.append(
+                f"  last collective      = {last} "
+                f"(host {h.get('process_index', '?')})"
+            )
     return lines
 
 
@@ -336,6 +401,10 @@ def summarize(path: str) -> str:
         if profiler:
             lines.append("")
             lines.extend(profiler)
+    measured = format_comms_measured(comms_measured(path))
+    if measured:
+        lines.append("")
+        lines.extend(measured)
     return "\n".join(lines)
 
 
@@ -392,4 +461,7 @@ def summarize_json(path: str) -> dict:
             for name, h in sorted(phases.items())
         },
         "counters": counters,
+        # measured comms evidence (exposure record + hop-monitor health;
+        # docs/comms.md) — None when the run left none
+        "comms": comms_measured(path) or None,
     }

@@ -1,0 +1,433 @@
+"""``make comms-demo`` — end-to-end proof of the comms observatory.
+
+The acceptance story (docs/comms.md), run as one live circuit on a
+4-virtual-device CPU mesh (exit nonzero on any miss; CI runs this
+beside chaos-demo as a living gate):
+
+1. **Measure, don't assume**: ``tpu-ddp comms bench`` times the real
+   XLA all-reduce AND the hand-rolled quantized rings (f32 + int8) at
+   two payload sizes, fits per-link α-β models, and the fitted lines
+   must be monotone in bytes-on-wire. The int8 ring's wire bytes at
+   equal payload must beat the f32 ring's — the whole point of
+   quantized gradient exchange, now measured rather than asserted.
+2. **The artifact is a citizen**: the bench artifact registry-records
+   with kind ``comms`` (``registry record`` classifies it; ``bench
+   compare`` can gate it later).
+3. **Calibration closes the loop**: ``tpu-ddp tune --comms-from`` must
+   consume the fitted model — the tune artifact names the calibration
+   source, and dp vs grad-compress price DIFFERENT step times from the
+   measured lines. Without ``--comms-from`` the CPU chip is unpriceable
+   and tune must refuse by name.
+4. **The alert fires on real wire silence**: a live ``--comms-monitor``
+   run under a chaos ``comm_stall`` (one ring hop sleeps inside the
+   collective) must raise COM001 — measured per-axis bandwidth collapse
+   vs the calibrated baseline — and NOTHING else. Afterwards
+   ``tpu-ddp comms exposure`` measures the run's exposed-comm share and
+   ``trace summarize`` shows the measured block next to the accounted
+   one.
+5. **Hangs name their collective**: a child run whose ring wedges for
+   good (comm_stall longer than the watchdog deadline, ``--watchdog
+   -abort``) must die with the hang exit code, leave a forensics bundle
+   whose ``suspect_collective`` matches the program-order schedule
+   (``tpu-ddp comms forensics``), classify as ``hang`` through the
+   supervisor's death taxonomy, and carry the suspect into the goodput
+   ledger's notes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+
+def _fail(msg: str) -> None:
+    print(f"[comms-demo] FAIL: {msg}", file=sys.stderr)
+
+
+def _cli(argv) -> tuple:
+    from tpu_ddp.cli.main import main as cli_main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(list(argv))
+    return rc, buf.getvalue()
+
+
+def _force_cpu(n: int) -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+# -- stage 1+2: measure real rings, fit, registry-record ------------------
+
+def check_bench(art_path: str, registry_dir: str) -> bool:
+    rc, out = _cli([
+        "comms", "bench",
+        "--kinds", "all-reduce,ring-all-reduce",
+        "--ring-modes", "f32,int8",
+        "--sizes", "4096,16384",
+        "--reps", "2",
+        "--out", art_path, "--json",
+    ])
+    if rc != 0:
+        _fail(f"comms bench exited {rc}")
+        return False
+    with open(art_path) as f:
+        art = json.load(f)
+    comms = art.get("comms") or {}
+    links = comms.get("links") or {}
+    sweeps = comms.get("sweeps") or []
+    needed = {"ring-all-reduce/f32/data", "ring-all-reduce/s8/data"}
+    if not needed <= set(links):
+        _fail(f"bench fitted {sorted(links)}; wanted at least {needed}")
+        return False
+    # the fitted α-β lines must be monotone in wire bytes: α >= 0 and a
+    # positive finite β make time strictly increasing — assert on the
+    # measured wire sizes, not just the fit's shape
+    for key, link in links.items():
+        alpha, beta = link.get("alpha_s"), link.get("beta_bytes_per_s")
+        if not (isinstance(alpha, (int, float)) and alpha >= 0.0):
+            _fail(f"link {key}: alpha_s {alpha!r} not >= 0")
+            return False
+        if not (isinstance(beta, (int, float)) and beta > 0.0):
+            _fail(f"link {key}: beta_bytes_per_s {beta!r} not > 0")
+            return False
+        lo, hi = alpha + 4096 / beta, alpha + 16384 / beta
+        if not hi > lo:
+            _fail(f"link {key}: fitted time not monotone in wire bytes")
+            return False
+    # int8 ring must move fewer bytes on the wire than the f32 ring at
+    # equal per-device payload — from the MEASURED sweep rows
+    wire = {}
+    for row in sweeps:
+        if row.get("kind") == "ring-all-reduce":
+            wire[(row.get("dtype"), row.get("size"))] = row.get("wire_bytes")
+    for size in (4096, 16384):
+        w8, w32 = wire.get(("s8", size)), wire.get(("f32", size))
+        if not (isinstance(w8, (int, float)) and isinstance(
+                w32, (int, float)) and w8 < w32):
+            _fail(f"int8 ring wire bytes {w8!r} not < f32 {w32!r} "
+                  f"at size {size}")
+            return False
+    print(f"[comms-demo] bench: {len(links)} links fitted, monotone; "
+          f"int8 ring wire bytes beat f32 at equal payload")
+    # the artifact is a registry citizen with its own kind
+    from tpu_ddp.registry.store import record_artifact
+
+    entry = record_artifact(registry_dir, art_path,
+                            note="comms-demo calibration")
+    if entry.artifact_kind != "comms":
+        _fail(f"registry classified the bench artifact as "
+              f"{entry.artifact_kind!r}, not 'comms'")
+        return False
+    print(f"[comms-demo] registry: recorded {entry.entry_id} "
+          f"kind={entry.artifact_kind}")
+    return True
+
+
+# -- stage 3: the tuner consumes the fitted model -------------------------
+
+def check_tune(art_path: str, tmp: str) -> bool:
+    # without calibration the CPU chip is unpriceable: refuse by name
+    rc, _ = _cli(["tune", "--chip", "cpu", "--devices", "4",
+                  "--strategies", "dp", "--batches", "8",
+                  "--steps-per-call", "1"])
+    if rc == 0:
+        _fail("tune priced the cpu chip without --comms-from")
+        return False
+    out_json = os.path.join(tmp, "tune.json")
+    rc, _ = _cli(["tune", "--chip", "cpu", "--devices", "4",
+                  "--comms-from", art_path,
+                  "--strategies", "dp,grad_compress",
+                  "--batches", "8", "--steps-per-call", "1",
+                  "--json", out_json])
+    if rc != 0:
+        _fail(f"tune --comms-from exited {rc}")
+        return False
+    with open(out_json) as f:
+        tune = json.load(f).get("tune") or {}
+    calib = tune.get("comms_calibration") or {}
+    src = str(calib.get("source") or "")
+    if os.path.basename(art_path) not in src:
+        _fail(f"tune artifact names calibration source {src!r}, "
+              f"not the bench artifact")
+        return False
+    steps = {}
+    for cand in tune.get("ranked") or []:
+        key = cand.get("grad_compress") or "none"
+        steps[key] = cand.get("predicted_step_us")
+    t_dp, t_gc = steps.get("none"), steps.get("int8")
+    if not (isinstance(t_dp, (int, float)) and isinstance(
+            t_gc, (int, float)) and t_dp != t_gc):
+        _fail(f"calibrated tune priced dp={t_dp!r} grad_compress={t_gc!r}"
+              " — expected two different measured-line prices")
+        return False
+    print(f"[comms-demo] tune: calibrated from {os.path.basename(src)}; "
+          f"dp {t_dp / 1e3:.2f}ms vs grad_compress {t_gc / 1e3:.2f}ms")
+    return True
+
+
+# -- stage 4: live COM001 under a chaos comm_stall ------------------------
+
+STALL_SPEC = {
+    "chaos_schema_version": 1,
+    "seed": 0,
+    "faults": [
+        # one ring hop sleeps 30s inside the collective at step 3: long
+        # enough that the frozen health file's staleness-adjusted
+        # bandwidth decays well under 25% of any plausible calibrated
+        # baseline, short enough that the run then finishes clean
+        {"kind": "comm_stall", "step": 3, "delay_s": 30.0, "hops": 1},
+    ],
+}
+
+
+def _stall_config(run_dir: str, spec_path: str):
+    from tpu_ddp.train.trainer import TrainConfig
+
+    return TrainConfig(
+        synthetic_data=True,
+        synthetic_size=256,
+        epochs=1,
+        n_devices=4,
+        per_shard_batch=8,
+        grad_compress="int8",
+        prefetch_depth=0,
+        mem_sample_steps=0,
+        log_every_epochs=99,
+        telemetry_dir=run_dir,
+        telemetry_sinks="jsonl",
+        comms_monitor=True,
+        chaos_spec=spec_path,
+    ).validate()
+
+
+def check_com001(run_dir: str, art_path: str) -> bool:
+    from tpu_ddp.monitor.aggregate import FleetAggregator, MonitorConfig
+    from tpu_ddp.monitor.alerts import AlertEngine
+    from tpu_ddp.train.trainer import Trainer
+
+    spec_path = os.path.join(run_dir, "chaos-stall.json")
+    os.makedirs(run_dir, exist_ok=True)
+    with open(spec_path, "w") as f:
+        json.dump(STALL_SPEC, f, indent=1)
+
+    result = {}
+
+    def _train():
+        try:
+            trainer = Trainer(_stall_config(run_dir, spec_path))
+            trainer.run()
+            result["ok"] = True
+        except BaseException as e:  # surfaced after join
+            result["error"] = repr(e)
+
+    t = threading.Thread(target=_train, daemon=True)
+    t.start()
+
+    # every rule except COM001 is pushed out of reach: the stall WILL
+    # crater steps/sec and data-wait shares, and the demo must prove the
+    # comm alert is the one that names the cause
+    cfg = MonitorConfig(
+        comms_baseline=art_path,
+        steps_per_sec_collapse_frac=0.01,
+        data_wait_share_max=2.0,
+        heartbeat_stale_seconds=600.0,
+    ).validate()
+    agg = FleetAggregator(run_dir, cfg)
+    engine = AlertEngine(cfg, run_dir=run_dir, actions=(), once=True)
+    fired = {}
+    deadline = time.time() + 180.0
+    while time.time() < deadline:
+        for alert in engine.evaluate(agg.poll()):
+            if alert.state == "firing":
+                fired[alert.rule] = alert.message
+        if "COM001" in fired:
+            break
+        time.sleep(0.5)
+    t.join(timeout=180.0)
+    if t.is_alive():
+        _fail("stall run did not finish within its deadline")
+        return False
+    if "error" in result:
+        _fail(f"stall run raised: {result['error']}")
+        return False
+    if set(fired) != {"COM001"}:
+        _fail(f"expected exactly COM001 during the stall; fired: "
+              f"{sorted(fired) or 'nothing'}")
+        return False
+    msg = fired["COM001"]
+    if "in flight" not in msg or "calibrated" not in msg:
+        _fail(f"COM001 message lacks the in-flight/calibrated story: "
+              f"{msg!r}")
+        return False
+    print(f"[comms-demo] COM001 fired during the stall: {msg}")
+    return True
+
+
+def check_exposure(run_dir: str) -> bool:
+    rc, out = _cli(["comms", "exposure", run_dir, "--reps", "2",
+                    "--json"])
+    if rc != 0:
+        _fail(f"comms exposure exited {rc}: {out[-300:]}")
+        return False
+    rec = json.loads(out)
+    share = rec.get("measured_comm_share")
+    if not isinstance(share, (int, float)) or not 0.0 <= share <= 1.0:
+        _fail(f"measured_comm_share {share!r} not in [0, 1]")
+        return False
+    rc, out = _cli(["trace", "summarize", run_dir])
+    if rc != 0 or "comms (measured)" not in out:
+        _fail("trace summarize lacks the measured comms block")
+        return False
+    if "accounted" not in out:
+        _fail("trace summarize lacks the accounted comms block")
+        return False
+    print(f"[comms-demo] exposure: measured comm share "
+          f"{share:.1%}; summarize joins measured + accounted")
+    return True
+
+
+# -- stage 5: a wedged ring names its collective --------------------------
+
+HANG_SPEC = {
+    "chaos_schema_version": 1,
+    "seed": 0,
+    "faults": [
+        {"kind": "comm_stall", "step": 2, "delay_s": 600.0, "hops": 1},
+    ],
+}
+
+
+def check_hang(run_dir: str) -> bool:
+    from tpu_ddp.telemetry.watchdog import HANG_EXIT_CODE
+
+    os.makedirs(run_dir, exist_ok=True)
+    spec_path = os.path.join(run_dir, "chaos-hang.json")
+    with open(spec_path, "w") as f:
+        json.dump(HANG_SPEC, f, indent=1)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    argv = [
+        sys.executable, "-m", "tpu_ddp.cli.train",
+        "--device", "cpu", "--synthetic-data", "--synthetic-size", "256",
+        "--batch-size", "8", "--epochs", "1",
+        "--grad-compress", "int8", "--prefetch-depth", "0",
+        "--telemetry-dir", run_dir, "--telemetry-sinks", "jsonl",
+        "--comms-monitor", "--chaos", spec_path,
+        "--watchdog-deadline", "35", "--watchdog-abort",
+    ]
+    try:
+        proc = subprocess.run(argv, env=env, timeout=300,
+                              capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        _fail("hang child outlived its 300s timeout — watchdog abort "
+              "never fired")
+        return False
+    if proc.returncode != HANG_EXIT_CODE:
+        _fail(f"hang child exited {proc.returncode}, expected the hang "
+              f"exit code {HANG_EXIT_CODE}; stderr tail: "
+              f"{proc.stderr[-400:]}")
+        return False
+    bundle_path = os.path.join(run_dir, "hang-forensics-p0.json")
+    if not os.path.exists(bundle_path):
+        _fail("watchdog abort left no hang-forensics-p0.json")
+        return False
+    with open(bundle_path) as f:
+        bundle = json.load(f)
+    suspect = bundle.get("suspect_collective")
+    if not isinstance(suspect, dict) or "ring" not in str(
+            suspect.get("key")):
+        _fail(f"hang bundle suspect_collective {suspect!r} does not "
+              "name the quantized ring")
+        return False
+    # the CLI joins the suspect against the rebuilt program order
+    rc, out = _cli(["comms", "forensics", run_dir, "--json"])
+    if rc != 0:
+        _fail(f"comms forensics exited {rc}")
+        return False
+    rec = json.loads(out)
+    if not rec.get("program_order_match"):
+        _fail(f"suspect {rec.get('suspect_collective')!r} matched "
+              "nothing in the program-order schedule")
+        return False
+    # the supervisor's death taxonomy sees a hang, not a kill
+    from tpu_ddp.elastic.supervisor import classify_exit
+
+    klass = classify_exit(run_dir, 0)
+    if klass != "hang":
+        _fail(f"classify_exit said {klass!r}, expected 'hang'")
+        return False
+    # ...and the goodput ledger carries the suspect into its notes
+    rc, out = _cli(["goodput", run_dir, "--json"])
+    if rc != 0:
+        _fail(f"goodput exited {rc}")
+        return False
+    ledger = json.loads(out).get("ledger") or {}
+    notes = " ".join(ledger.get("notes") or [])
+    if "hang forensics suspect collective" not in notes:
+        _fail(f"goodput notes lack the hang forensics join: {notes!r}")
+        return False
+    exits = [i.get("exit") for i in ledger.get("incarnations") or []]
+    if "hang" not in exits:
+        _fail(f"goodput incarnation exits {exits} lack 'hang'")
+        return False
+    key = suspect.get("key")
+    print(f"[comms-demo] hang: exit {proc.returncode}, suspect {key} "
+          f"matches program order; classified 'hang'; ledger notes "
+          f"carry the suspect")
+    return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", default="/tmp/tpu_ddp_comms_demo",
+                    help="scratch dir (wiped)")
+    args = ap.parse_args(argv)
+    _force_cpu(4)
+    shutil.rmtree(args.dir, ignore_errors=True)
+    os.makedirs(args.dir, exist_ok=True)
+    art_path = os.path.join(args.dir, "comms-bench.json")
+    registry_dir = os.path.join(args.dir, "registry")
+    stall_dir = os.path.join(args.dir, "stall-run")
+    hang_dir = os.path.join(args.dir, "hang-run")
+    stages = (
+        ("bench+registry", lambda: check_bench(art_path, registry_dir)),
+        ("tune", lambda: check_tune(art_path, args.dir)),
+        ("com001", lambda: check_com001(stall_dir, art_path)),
+        ("exposure", lambda: check_exposure(stall_dir)),
+        ("hang", lambda: check_hang(hang_dir)),
+    )
+    for name, stage in stages:
+        print(f"[comms-demo] --- {name} ---")
+        try:
+            ok = stage()
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            _fail(f"stage {name} raised: {e!r}")
+            ok = False
+        if not ok:
+            return 1
+    print("[comms-demo] PASS: measured rings fitted monotone, int8 beat "
+          "f32 on the wire, tune priced from the measured lines, the "
+          "stall raised exactly COM001, and the hang named its ring.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

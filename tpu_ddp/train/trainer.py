@@ -259,6 +259,16 @@ class TrainConfig:
                                           # and fire-once per logical run
                                           # — the elastic runtime's CI
                                           # harness (docs/resilience.md)
+    comms_monitor: bool = False           # instrument the quantized ring
+                                          # collectives with a per-hop
+                                          # host callback: live per-axis
+                                          # bandwidth in comms-health-
+                                          # p<i>.json + the stuck-
+                                          # collective suspect for hang
+                                          # forensics (docs/comms.md).
+                                          # Changes the traced program
+                                          # (adds host transfers), so it
+                                          # refuses --lint-on-start
     health: str = "off"                   # "on": numerics flight recorder —
                                           # in-graph grad/param/update norms
                                           # + NaN/Inf sentinels every step
@@ -368,6 +378,21 @@ class TrainConfig:
                 "--watchdog-abort needs --watchdog-deadline > 0: there "
                 "is no hang detector to escalate from"
             )
+        if self.comms_monitor:
+            if not self.telemetry_dir:
+                raise ValueError(
+                    "--comms-monitor needs --telemetry-dir: the per-axis "
+                    "health records and the hang-forensics suspect live "
+                    "in the run dir"
+                )
+            if self.lint_on_start:
+                raise ValueError(
+                    "--comms-monitor does not compose with "
+                    "--lint-on-start: the per-hop host callback is a "
+                    "deliberate host transfer inside the step, which "
+                    "the lint's host-transfer rule would (correctly) "
+                    "refuse"
+                )
         if self.chaos_spec:
             if not self.telemetry_dir:
                 raise ValueError(
@@ -379,7 +404,15 @@ class TrainConfig:
 
             # parse + validate NOW: a typo'd fault spec must refuse the
             # launch, not detonate at its trigger step
-            load_spec(self.chaos_spec)
+            spec = load_spec(self.chaos_spec)
+            if any(f.get("kind") == "comm_stall" for f in spec["faults"]) \
+                    and not self.comms_monitor:
+                raise ValueError(
+                    "chaos spec contains a comm_stall fault but "
+                    "--comms-monitor is off: the stall fires from the "
+                    "per-hop callback seam, so without the monitor the "
+                    "fault can never trigger"
+                )
         if self.zero1 and self.optimizer == "lamb":
             raise ValueError(
                 "--zero1 does not compose with --optimizer lamb (the "
@@ -690,6 +723,30 @@ class Trainer:
                 checkpoint_dir=config.checkpoint_dir,
                 telemetry=self.telemetry,
             )
+
+        # Comms observatory (docs/comms.md): per-hop host callback on the
+        # quantized ring collectives -> live per-axis bandwidth + the
+        # in-flight collective, the hang forensics' suspect evidence.
+        # Installed BEFORE the strategy builds its jitted step so the
+        # hook is baked into the traced ring; the chaos comm_stall fault
+        # rides the same seam (fault_hook), which is why the injector
+        # must exist first.
+        self._comms_monitor = None
+        if config.comms_monitor:
+            from tpu_ddp.comms.forensics import HopMonitor
+            from tpu_ddp.parallel.collectives import set_ring_hop_hook
+
+            self._comms_monitor = HopMonitor(
+                config.telemetry_dir,
+                process_index=self.process_index,
+                n_devices=len(devices),
+                fault_hook=(
+                    self._chaos.comm_stall_hook
+                    if self._chaos is not None else None
+                ),
+                telemetry=self.telemetry,
+            )
+            set_ring_hop_hook(self._comms_monitor.on_hop)
 
         # Live memory sampler (docs/memory.md): per-step device
         # memory_stats -> memory/* gauges + the incarnation-stamped
@@ -1405,6 +1462,14 @@ class Trainer:
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
+        if self._comms_monitor is not None:
+            # uninstall the hop hook BEFORE closing: a straggling
+            # dispatch must not write through a closed monitor
+            from tpu_ddp.parallel.collectives import set_ring_hop_hook
+
+            set_ring_hop_hook(None)
+            self._comms_monitor.close()
+            self._comms_monitor = None
         if self._memtrack is not None:
             self._memtrack.close()
         if self._health_monitor is not None:
@@ -1711,11 +1776,28 @@ class Trainer:
         if c.watchdog_deadline_seconds > 0:
             from tpu_ddp.telemetry import HangWatchdog
 
+            on_hang = None
+            if self._comms_monitor is not None and c.telemetry_dir:
+                # stuck-collective forensics (docs/comms.md): join the
+                # stack dump with the last comms-health record so the
+                # hang bundle NAMES the suspect collective — written
+                # before the abort escalation, because after it there
+                # is no process left to ask
+                from tpu_ddp.comms.forensics import write_hang_bundle
+
+                run_dir = c.telemetry_dir
+                pidx = self.process_index
+
+                def on_hang(dump: str, _dir=run_dir, _p=pidx) -> None:
+                    write_hang_bundle(_dir, process_index=_p,
+                                      dump_text=dump)
+
             self._watchdog = HangWatchdog(
                 c.watchdog_deadline_seconds,
                 heartbeat_dir=c.telemetry_dir,
                 process_index=self.process_index,
                 telemetry=tel,
+                on_hang=on_hang,
                 abort_on_hang=c.watchdog_abort,
             ).start()
         if c.monitor_port:
@@ -1801,6 +1883,7 @@ class Trainer:
                 or self._health_monitor is not None
                 or self._memtrack is not None
                 or self._chaos is not None
+                or self._comms_monitor is not None
                 or (self.checkpointer is not None
                     and c.checkpoint_steps > 0)
             )
@@ -1876,6 +1959,10 @@ class Trainer:
                     # here, so the beat above is the last one — exactly
                     # the silhouette of a wedged collective
                     self._chaos.on_step(host_step)
+                if self._comms_monitor is not None:
+                    # stamp the host step onto subsequent hop records so
+                    # the hang forensics can say WHEN the ring wedged
+                    self._comms_monitor.set_step(host_step)
                 if self._capture is not None:
                     # capture-window lifecycle: opens an armed window when
                     # its start step arrives, closes + writes the bundle

@@ -158,7 +158,8 @@ def render_result(result, *, top: int = 0) -> str:
         f"calibration x{result.calibration_ratio:g} "
         f"[{result.calibration_source}], hbm "
         f"x{result.hbm_calibration_ratio:g} "
-        f"[{result.hbm_calibration_source}])",
+        f"[{result.hbm_calibration_source}], comms "
+        f"[{result.comms_calibration_source}])",
         "",
     ]
     rows = result.ranked[:top] if top else result.ranked
@@ -215,6 +216,7 @@ def tune_artifact(result) -> dict:
                         "source": result.calibration_source},
         "hbm_calibration": {"ratio": result.hbm_calibration_ratio,
                             "source": result.hbm_calibration_source},
+        "comms_calibration": {"source": result.comms_calibration_source},
         "grid": result.grid_descriptor(),
         "n_candidates": len(result.ranked) + len(result.excluded),
         "n_ranked": len(result.ranked),
@@ -316,10 +318,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "artifact (measured HBM-cap calibration) to "
                          "read measured-over-predicted ratios from "
                          "(repeatable)")
+    ap.add_argument("--comms-from", action="append", default=[],
+                    metavar="PATH", dest="comms_from",
+                    help="`tpu-ddp comms bench --json` artifact whose "
+                         "fitted alpha-beta link model replaces the "
+                         "spec-sheet ICI term in every candidate's "
+                         "roofline (repeatable; wrong-chip evidence is "
+                         "ignored; docs/comms.md). With measured comms "
+                         "evidence, peak-less chips (cpu) price on the "
+                         "comm term alone")
     ap.add_argument("--registry", default=None, metavar="DIR",
                     help="perf-registry workspace: archived validated "
                          "tune entries join the time calibration, "
-                         "mem-kind entries the HBM-cap calibration")
+                         "mem-kind entries the HBM-cap calibration, "
+                         "comms-kind entries the interconnect model")
     ap.add_argument("--top", type=int, default=15,
                     help="ranked rows to print (0 = all)")
     ap.add_argument("--json", default=None,
@@ -364,10 +376,21 @@ def _run(args) -> int:
     devices = local[:n]
     chip = args.chip or devices[0].device_kind
     spec = chip_spec(chip)
-    if spec is None or spec.peak_bf16_flops is None:
+    # measured interconnect model (docs/comms.md): `comms bench`
+    # artifacts + comms-kind registry entries; with evidence, the
+    # roofline's ICI term is measurement, and a peak-less chip (cpu)
+    # becomes priceable on its comm term alone
+    from tpu_ddp.comms.model import comms_model_for_chip
+
+    comms_model = comms_model_for_chip(
+        chip, sources=args.comms_from, registry_dir=args.registry)
+    if spec is None or (spec.peak_bf16_flops is None
+                        and not comms_model):
         raise ValueError(
             f"no published peak for {chip!r}: pass --chip v5e (or "
-            "another CHIP_SPECS key) to price against real hardware"
+            "another CHIP_SPECS key) to price against real hardware — "
+            "or --comms-from with measured comms evidence for this "
+            "chip (comm-term-only pricing)"
         )
 
     model, model_label = build_tune_model(
@@ -415,6 +438,9 @@ def _run(args) -> int:
         calibration_source=calibration.source,
         hbm_calibration_ratio=hbm_calibration.ratio,
         hbm_calibration_source=hbm_calibration.source,
+        comms_model=comms_model or None,
+        comms_calibration_source=comms_model.source
+        if comms_model else "none",
         dispatch_overhead_s=(
             args.dispatch_overhead_us * 1e-6
             if args.dispatch_overhead_us is not None

@@ -134,6 +134,10 @@ class TuneResult:
     # compiled peak before the over_hbm verdict
     hbm_calibration_ratio: float = 1.0
     hbm_calibration_source: str = "none"
+    # measured interconnect calibration (docs/comms.md): names the
+    # `--comms-from` evidence whose α-β link model replaced the
+    # spec-sheet ICI term in every candidate's roofline
+    comms_calibration_source: str = "none"
 
     @property
     def winner(self) -> Optional[PricedCandidate]:
@@ -157,6 +161,7 @@ class TuneResult:
                 self.dispatch_overhead_s * 1e6, 1),
             "calibration_ratio": self.calibration_ratio,
             "hbm_calibration_ratio": self.hbm_calibration_ratio,
+            "comms_calibration_source": self.comms_calibration_source,
         }
 
 
@@ -242,6 +247,7 @@ def price_anatomy(
     overlap: str = "overlapped",
     lint_rule_counts: Optional[Dict[str, int]] = None,
     lint_errors: Sequence[str] = (),
+    comms_model=None,
 ) -> PricedCandidate:
     """The pure pricing tail over an already-extracted anatomy: lint
     verdict -> HBM cap -> roofline -> calibration -> dispatch
@@ -252,7 +258,12 @@ def price_anatomy(
     the memory truth loop (``tpu-ddp mem``, docs/memory.md): the
     capacity gate checks ``peak * ratio`` against the chip's HBM, so a
     chip kind whose measured high-water runs hot against the static
-    plan excludes borderline candidates BEFORE they OOM on hardware."""
+    plan excludes borderline candidates BEFORE they OOM on hardware.
+
+    ``comms_model`` (a ``comms/model.py`` LinkModel with evidence)
+    swaps the roofline's spec-sheet ICI term for measured per-link α-β
+    pricing — and unlocks peak-less chips (CPU hosts): their price is
+    comm-term-only, honest about what was measured."""
     from tpu_ddp.analysis.roofline import chip_spec, roofline
 
     name = cand.name(n_devices)
@@ -264,10 +275,12 @@ def price_anatomy(
             peak_bytes=anatomy.peak_bytes,
         )
     spec = chip_spec(chip)
-    if spec is None or spec.peak_bf16_flops is None:
+    if spec is None or (spec.peak_bf16_flops is None
+                        and not comms_model):
         raise ValueError(
             f"no published peak for chip {chip!r}: pass --chip with a "
-            "CHIP_SPECS key (v2..v6e)"
+            "CHIP_SPECS key (v2..v6e), or --comms-from with measured "
+            "comms evidence for this chip (comm-term-only pricing)"
         )
     peak = anatomy.peak_bytes
     expected_peak = (peak * hbm_calibration_ratio
@@ -287,7 +300,8 @@ def price_anatomy(
             peak_bytes=peak, hbm_fraction=round(hbm_fraction, 4),
             lint_rule_counts=counts,
         )
-    rl = roofline(anatomy, chip, overlap=overlap)
+    rl = roofline(anatomy, chip, overlap=overlap,
+                  comms_model=comms_model)
     if not rl.predicted_step_s:
         return PricedCandidate(
             candidate=cand, name=name, status=STATUS_UNPRICEABLE,
@@ -328,6 +342,8 @@ def tune(
     calibration_source: str = "none",
     hbm_calibration_ratio: float = 1.0,
     hbm_calibration_source: str = "none",
+    comms_model=None,
+    comms_calibration_source: str = "none",
     dispatch_overhead_s: float = DEFAULT_DISPATCH_OVERHEAD_S,
     overlap: str = "overlapped",
     lint_config=None,
@@ -341,10 +357,12 @@ def tune(
     from tpu_ddp.analysis.roofline import chip_spec
 
     spec = chip_spec(chip)
-    if spec is None or spec.peak_bf16_flops is None:
+    if spec is None or (spec.peak_bf16_flops is None
+                        and not comms_model):
         raise ValueError(
             f"no published peak for chip {chip!r}: pass --chip with a "
-            "CHIP_SPECS key (v2..v6e)"
+            "CHIP_SPECS key (v2..v6e), or --comms-from with measured "
+            "comms evidence for this chip (comm-term-only pricing)"
         )
     devices = list(devices)
     n = len(devices)
@@ -386,6 +404,7 @@ def tune(
             hbm_calibration_ratio=hbm_calibration_ratio,
             dispatch_overhead_s=dispatch_overhead_s, overlap=overlap,
             lint_rule_counts=rule_counts(findings), lint_errors=errors,
+            comms_model=comms_model,
         )
         (ranked if priced.status == STATUS_OK else excluded).append(priced)
     ranked.sort(key=lambda p: (-p.predicted_images_per_sec_per_chip,
@@ -398,6 +417,7 @@ def tune(
         calibration_source=calibration_source,
         hbm_calibration_ratio=hbm_calibration_ratio,
         hbm_calibration_source=hbm_calibration_source,
+        comms_calibration_source=comms_calibration_source,
         ranked=ranked, excluded=excluded,
         compiled_programs=len(audits),
         image_size=image_size, overlap=overlap,
