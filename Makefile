@@ -6,7 +6,8 @@ PYTHON ?= python
 .PHONY: test test-all dryrun bench smoke capture aot real-data lint \
 	trace-demo health-demo zero-demo compress-demo analyze-demo \
 	lint-demo monitor-demo profile-demo goodput-demo registry-demo \
-	tune-demo mem-demo curves-demo chaos-demo comms-demo bench-compare
+	tune-demo mem-demo curves-demo chaos-demo comms-demo data-demo \
+	bench-compare
 
 # Fast default loop (round-3 verdict item 5): skips the `slow`-marked
 # multi-process / end-to-end-CLI / AOT tests. CI and pre-commit should run
@@ -283,6 +284,23 @@ comms-demo:
 	rm -rf $(COMMS_DEMO_DIR)
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
 	  $(PYTHON) -m tpu_ddp.tools.comms_demo --dir $(COMMS_DEMO_DIR)
+
+# Data-path observatory acceptance (docs/data.md): `tpu-ddp data bench`
+# must measure every loader stage and `registry record` as kind "data";
+# a live staged-pipeline run under a chaos per-stage data_stall must
+# raise exactly DAT001 naming the stalled stage against the benched
+# busy-rate baseline, and `tpu-ddp data report` must call that stage
+# dominant; a supervised kill -> 8-to-4 re-mesh resume must leave
+# replayed digests `tpu-ddp data audit` verifies bit-identical (a
+# mutated digest fails closed by step); `tpu-ddp tune --data-from` must
+# price the measured input floor and exclude unfeedable candidates
+# input_bound by name; and the artifact must self-compare clean. Exits
+# nonzero on any miss (tpu_ddp/tools/data_demo.py).
+DATA_DEMO_DIR ?= /tmp/tpu_ddp_data_demo
+data-demo:
+	rm -rf $(DATA_DEMO_DIR)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+	  $(PYTHON) -m tpu_ddp.tools.data_demo --dir $(DATA_DEMO_DIR)
 
 # Deviceless perf-regression gate: re-capture the AOT artifact with the
 # real XLA:TPU toolchain (needs libtpu; ~30+ min of compiles) and diff

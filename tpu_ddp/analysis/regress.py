@@ -59,13 +59,15 @@ _SIZE_KEYS = (
     "flops", "bytes_accessed", "predicted_step_us",
     "measured_high_water_bytes",
     "time_to_target_steps", "final_eval_loss", "alpha_s",
+    "batch_time_s", "per_image_s", "seconds_per_batch",
 )
 _SIZE_NOISE_FLOOR = 1024
 
 #: sized keys at UNIT scale (a loss ~2.3, a step count ~100): the 1 KiB
 #: byte-noise floor would swallow them entirely, so these gate on the
 #: relative tolerance alone
-_UNIT_SIZE_KEYS = ("time_to_target_steps", "final_eval_loss", "alpha_s")
+_UNIT_SIZE_KEYS = ("time_to_target_steps", "final_eval_loss", "alpha_s",
+                   "batch_time_s", "per_image_s", "seconds_per_batch")
 
 #: count metrics (exact): any increase is a regression
 _COUNT_KEYS = ("s8_collective_permute_count", "f32_collective_permute_count",
@@ -98,7 +100,8 @@ _WALL_KEYS = ("compile_wall_s", "elapsed_s")
 #: regression, a rise an improvement — mirroring the sized-metric gate
 #: with the sign flipped
 _QUALITY_KEYS = ("goodput_fraction", "predicted_images_per_sec_per_chip",
-                 "final_eval_accuracy", "achieved_bw_bytes_per_s")
+                 "final_eval_accuracy", "achieved_bw_bytes_per_s",
+                 "batches_per_s", "bytes_per_s")
 
 
 def load_artifact(path: str) -> Dict[str, dict]:
@@ -151,6 +154,19 @@ def normalize_artifact(art, path: str = "<artifact>") -> Dict[str, dict]:
         # evidence, not gates
         return {"comms": {k: v for k, v in art["comms"].items()
                           if k not in ("sweeps", "skipped")}}
+    if "data_schema_version" in art and isinstance(art.get("data"), dict):
+        # `tpu-ddp data bench --json`: the headline loader throughput
+        # gates as quality and the end-to-end batch time / per-image
+        # cost as unit-scale sizes; each benched stage gates as its own
+        # program (a stage that got slower — or stopped benching — is a
+        # named regression), raw skips/rows are evidence, not gates
+        data = art["data"]
+        out = {"data": {k: v for k, v in data.items()
+                        if k not in ("stages", "rows", "skipped")}}
+        for stage, rec in (data.get("stages") or {}).items():
+            if isinstance(rec, dict):
+                out[f"data/{stage}"] = dict(rec)
+        return out
     if art.get("type") == "trace_summary" and isinstance(
             art.get("phases"), dict):
         # `tpu-ddp trace summarize --json`: measured per-phase

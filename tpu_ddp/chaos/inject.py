@@ -21,7 +21,16 @@ Five fault kinds, each modeled on a failure the fleet actually suffers
   ``fault_hook`` for the first N save attempts at/after a step: the
   bounded-backoff retry path must absorb it.
 - ``data_stall`` — sleeps the input pipeline at a step (the DWT-class
-  slow-loader incident).
+  slow-loader incident). With a ``stage`` field it instead wedges that
+  ONE named loader stage (``index``/``gather``/…/``h2d``) from the
+  staged pipeline's observer seam (``datapath/stages.py`` — the loader
+  mirror of the ring hop hook): the StageMonitor writes the stage
+  ``in_flight`` to the data-health file BEFORE the sleep, so DAT001 and
+  the hang forensics' ``suspect_stage`` name it while the step wedges.
+  ``batches`` (default 1) bounds how many entries of that stage stall.
+  Stage-targeted form needs the staged pipeline on the run
+  (``--prefetch-batches N`` or ``--prefetch-depth 0`` — the seam only
+  exists there).
 - ``comm_stall`` — stalls the gradient ring mid-collective: a
   deterministic per-hop delay raised from the ring hop hook seam
   (``parallel/collectives.py::set_ring_hop_hook``, ridden by the comms
@@ -135,6 +144,18 @@ def load_spec(path: str) -> dict:
             if not isinstance(stall, (int, float)) or stall < 0:
                 raise ValueError(f"{label}: 'stall_s' must be a number "
                                  f">= 0, got {stall!r}")
+            stage = fault.get("stage")
+            if stage is not None:
+                from tpu_ddp.datapath.stages import STAGES
+
+                if stage not in STAGES:
+                    raise ValueError(
+                        f"{label}: 'stage' must be one of "
+                        f"{', '.join(STAGES)}, got {stage!r}")
+                batches = fault.get("batches", 1)
+                if not isinstance(batches, int) or batches < 1:
+                    raise ValueError(f"{label}: 'batches' must be an int "
+                                     f">= 1, got {batches!r}")
         if kind == "comm_stall":
             delay = fault.get("delay_s", 30.0)
             if not isinstance(delay, (int, float)) or delay <= 0:
@@ -245,7 +266,9 @@ class ChaosInjector:
             if (not self._mine(fault) or self._fired(fault_id)
                     or step < int(fault["step"])
                     # hook-driven faults fire from their own seams
-                    or fault["kind"] in ("save_io_flake", "comm_stall")):
+                    or fault["kind"] in ("save_io_flake", "comm_stall")
+                    or (fault["kind"] == "data_stall"
+                        and fault.get("stage"))):
                 continue
             getattr(self, f"_fire_{fault['kind']}")(fault_id, fault, step)
 
@@ -438,3 +461,54 @@ class ChaosInjector:
         comms hop monitor (its seam) is on."""
         return any(f["kind"] == "comm_stall" and self._mine(f)
                    for f in self.faults)
+
+    # -- loader stage seam -------------------------------------------------
+
+    def data_stall_hook(self, stage: str) -> None:
+        """The StageMonitor's ``stall_hook`` (the staged loader's
+        observer seam, ``datapath/stages.py``): sleep ``stall_s`` at the
+        entry of the named stage for a stage-targeted ``data_stall``
+        fault's first N batches at/after its trigger step. Runs AFTER
+        the monitor's in-flight health write, so the wedged stage is
+        already named on disk when the watchdog fires and the hang
+        bundle's ``suspect_stage`` reads it. The remaining-batch count
+        persists in the chaos state file, so a resumed incarnation
+        doesn't stall again (fire-once per logical run)."""
+        for fault_id, fault in enumerate(self.faults):
+            if (fault["kind"] != "data_stall" or not self._mine(fault)
+                    or fault.get("stage") != stage):
+                continue
+            # during step N the loop's last on_step was N-1, so the
+            # fault for trigger step S is due once _last_step >= S - 1
+            # (under --prefetch-batches the producer runs ahead of the
+            # loop; the window is a floor, not an exact step match)
+            last = -1 if self._last_step is None else self._last_step
+            if last < int(fault["step"]) - 1:
+                continue
+            key = str(fault_id)
+            remaining = self._state["stall_remaining"].get(
+                key, int(fault.get("batches", 1)))
+            if remaining <= 0:
+                continue
+            self._state["stall_remaining"][key] = remaining - 1
+            if remaining - 1 <= 0 and not self._fired(fault_id):
+                self._state["fired"].append(fault_id)
+            self._save_state()
+            stall = float(fault.get("stall_s", 1.0))
+            self.telemetry.count("chaos/faults")
+            self.telemetry.instant(
+                "chaos_fault", kind="data_stall", fault_id=fault_id,
+                trigger_step=fault["step"], stage=stage,
+                stall_s=stall, remaining=remaining - 1)
+            log.warning(
+                "chaos: data_stall fault #%d wedging stage %s "
+                "for %.1fs (%d more batch(es) to stall)",
+                fault_id, stage, stall, remaining - 1)
+            time.sleep(stall)
+
+    def wants_data_stall_stage(self) -> bool:
+        """True when this host's share of the spec includes a
+        stage-targeted ``data_stall`` — the Trainer refuses such a spec
+        unless the staged pipeline (its seam) is on."""
+        return any(f["kind"] == "data_stall" and f.get("stage")
+                   and self._mine(f) for f in self.faults)

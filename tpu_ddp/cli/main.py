@@ -80,6 +80,15 @@ Subcommands:
   (non-overlapped) comm share against its comm-stripped twin, and name
   a hung run's suspect collective against the program-order schedule
   (docs/comms.md).
+- ``tpu-ddp data bench|audit|report`` — the data-path observatory:
+  measure per-stage loader microbenchmarks over the staged input
+  pipeline (schema-versioned artifact; registry kind "data", ``bench
+  compare`` gates per-stage throughput, ``tune --data-from`` consumes
+  the per-image cost), verify a run's seeded batch-content digests
+  replay identically across kill→resume and re-mesh (fail-closed,
+  naming the diverging step), and decompose a recorded run's
+  ``data_wait`` into per-stage percentiles with an input-bound verdict
+  (docs/data.md).
 - ``tpu-ddp tune`` — roofline-guided auto-tuner: enumerates parallelism
   strategy × mesh shape × ``--zero1``/``--grad-compress`` overlays ×
   batch × ``steps_per_call``, compiles every candidate devicelessly,
@@ -207,6 +216,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_ddp.comms.cli import main as comms_main
 
         return comms_main(argv[1:])
+    # data owns its argparse surface; bench touches jax only for the
+    # h2d stage (lazy), audit/report are stdlib-only file archaeology
+    if argv[:1] == ["data"]:
+        from tpu_ddp.datapath.cli import main as data_main
+
+        return data_main(argv[1:])
     if argv[:2] == ["bench", "compare"]:
         from tpu_ddp.analysis.regress import main as compare_main
 
@@ -294,6 +309,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="comms observatory: measured collective microbenchmarks + "
              "alpha-beta link calibration, exposed-comm attribution, "
              "stuck-collective forensics (tpu-ddp comms --help)",
+    )
+    sub.add_parser(
+        "data",
+        help="data-path observatory: per-stage loader microbenchmarks, "
+             "batch-provenance determinism audit across kill/resume and "
+             "re-mesh, per-stage data_wait decomposition "
+             "(tpu-ddp data --help)",
     )
     sub.add_parser(
         "tune",

@@ -357,6 +357,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefetch-depth", type=int, default=2,
                    help="batches assembled ahead on the native host "
                         "prefetcher (C++ ring buffer; 0 disables)")
+    p.add_argument("--prefetch-batches", type=int, default=0,
+                   help="batches buffered ahead by the STAGED background "
+                        "prefetcher (docs/data.md): per-stage data/* "
+                        "spans + queue-depth gauges, bit-identical "
+                        "batch stream; takes precedence over "
+                        "--prefetch-depth (0 = off)")
+    p.add_argument("--no-data-digests", dest="data_digests",
+                   action="store_false", default=True,
+                   help="skip the per-step batch-content digest sink "
+                        "(data-p<i>.jsonl) that `tpu-ddp data audit` "
+                        "verifies across restarts")
     return p
 
 
@@ -504,6 +515,8 @@ def config_from_args(args) -> TrainConfig:
         steps_per_call=args.steps_per_call,
         grad_accum_steps=args.grad_accum_steps,
         prefetch_depth=args.prefetch_depth,
+        prefetch_batches=args.prefetch_batches,
+        data_digests=args.data_digests,
     ).validate()  # satellite: bad sink/policy names fail at parse time
 
 

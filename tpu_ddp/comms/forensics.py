@@ -248,11 +248,18 @@ def write_hang_bundle(run_dir: str, *, process_index: int = 0,
     last_step = hb.get("step") if isinstance(hb, dict) else None
     stack_mentions_ring = bool(
         dump_text and any(s in dump_text for s in _RING_FRAMES))
+    # the data-path mirror: a stall-driven hang names the loader stage
+    # that wedged (docs/data.md), from the StageMonitor's in-flight
+    # marker — None is an honest "no staged-loader evidence"
+    from tpu_ddp.datapath.stages import suspect_stage_from_files
+
+    suspect_stage = suspect_stage_from_files(run_dir)
     rec = {
         "hang_forensics_schema_version": HANG_FORENSICS_SCHEMA_VERSION,
         "process_index": process_index,
         "last_step": last_step,
         "suspect_collective": suspect,
+        "suspect_stage": suspect_stage,
         "stack_mentions_ring": stack_mentions_ring,
         "health_files": len(healths),
     }

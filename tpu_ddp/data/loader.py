@@ -26,7 +26,8 @@ batch a jitted step sees has the same shape -> one compilation.
 from __future__ import annotations
 
 import math
-from typing import Dict, Iterator, Optional
+import time
+from typing import Callable, Dict, Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -37,6 +38,18 @@ def _gather(arr: np.ndarray, idx: np.ndarray) -> np.ndarray:
     from tpu_ddp import native
 
     return native.gather_rows(arr, idx)
+
+
+def _out_nbytes(out) -> int:
+    """Bytes produced by a stage — the throughput denominator the stage
+    observer reports (dict batch, (a, b) tuple, or a bare array)."""
+    if out is None:
+        return 0
+    if isinstance(out, dict):
+        return sum(int(getattr(v, "nbytes", 0)) for v in out.values())
+    if isinstance(out, tuple):
+        return sum(int(getattr(v, "nbytes", 0)) for v in out)
+    return int(getattr(out, "nbytes", 0))
 
 
 def shard_indices(
@@ -85,6 +98,8 @@ class ShardedBatchLoader:
         process_index: int = 0,
         process_count: int = 1,
         telemetry=None,
+        observer=None,
+        host_augment: Optional[Callable] = None,
     ):
         """exclude_sampler_pad: also mask out the sampler-level wrap-pad
         duplicates (the samples DistributedSampler repeats to even out
@@ -105,9 +120,19 @@ class ShardedBatchLoader:
         host-local array and ``process_count=1`` semantics apply per host.
 
         telemetry: optional ``tpu_ddp.telemetry.Telemetry`` — the loader
-        emits a ``data_gather`` span per assembled batch and counts
-        ``loader/batches`` (stdlib-only import, keeps this module
-        jax-free)."""
+        emits a ``data/<stage>`` span per pipeline stage per batch
+        (index/gather/augment/collate/shard — the datapath observatory
+        vocabulary, docs/data.md) and counts ``loader/batches``
+        (stdlib-only import, keeps this module jax-free).
+
+        observer: optional stage observer (duck-typed to
+        ``tpu_ddp.datapath.stages.StageMonitor``: ``stage_enter(stage)``
+        / ``stage_exit(stage, seconds, nbytes)``) — feeds the live
+        ``data-health-p<i>.json`` file and the chaos per-stage stall
+        seam. host_augment: optional host-side ``(images, labels) ->
+        (images, labels)`` hook timed as the ``augment`` stage; the
+        default pipeline augments on-device inside the jitted step, so
+        this stays a passthrough unless installed."""
         assert len(images) == len(labels)
         assert world_size % process_count == 0, (
             f"{world_size} devices not divisible by {process_count} hosts"
@@ -125,6 +150,8 @@ class ShardedBatchLoader:
         if telemetry is None:
             from tpu_ddp.telemetry import NULL as telemetry
         self.telemetry = telemetry
+        self.observer = observer
+        self.host_augment = host_augment
         self.local_world_size = world_size // process_count
         self._epoch = 0
         per_shard = math.ceil(len(images) / world_size)
@@ -189,14 +216,66 @@ class ShardedBatchLoader:
             hi_r = lo_r + self.local_world_size
             yield chunk[lo_r:hi_r].reshape(-1), mask[lo_r:hi_r].reshape(-1)
 
+    # -- the staged pipeline body (one method per named stage, so the
+    # -- microbenchmark times exactly the code the live path runs) ------
+
+    def _run_stage(self, stage: str, fn, *args):
+        """Time one stage: ``data/<stage>`` span + observer report.
+        Stage cost is measured here (not in the observer) so the span
+        and the health-window number can never disagree — and the
+        observer's entry seam (in-flight write + chaos stall hook) is
+        INSIDE the measured region, so an injected slow stage shows the
+        same ballooned seconds in the span, the report, and the DAT001
+        busy-rate window."""
+        obs = self.observer
+        t0 = time.perf_counter()
+        with self.telemetry.span(f"data/{stage}"):
+            if obs is not None:
+                obs.stage_enter(stage)
+            out = fn(*args)
+        if obs is not None:
+            obs.stage_exit(stage, time.perf_counter() - t0, _out_nbytes(out))
+        return out
+
+    def _stage_index(self, it) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        return next(it, None)
+
+    def _stage_gather(self, idx: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        return _gather(self.images, idx), _gather(self.labels, idx)
+
+    def _stage_augment(
+        self, images: np.ndarray, labels: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        if self.host_augment is None:
+            return images, labels
+        return self.host_augment(images, labels)
+
+    def _stage_collate(
+        self, images: np.ndarray, labels: np.ndarray, mask: np.ndarray
+    ) -> Dict[str, np.ndarray]:
+        return {"image": images, "label": labels, "mask": mask}
+
+    def _stage_shard(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        # device-layout prep: contiguous C-order rows for the h2d copy.
+        # A no-op (same array back, no value change) when the gather
+        # already produced contiguous output — yields stay bit-identical.
+        return {k: np.ascontiguousarray(v) for k, v in batch.items()}
+
     def epoch_batches(self, epoch: Optional[int] = None) -> Iterator[Dict[str, np.ndarray]]:
-        for idx, mask in self.epoch_index_batches(epoch):
-            with self.telemetry.span("data_gather"):
-                batch = {
-                    "image": _gather(self.images, idx),
-                    "label": _gather(self.labels, idx),
-                    "mask": mask,
-                }
+        it = self.epoch_index_batches(epoch)
+        while True:
+            pair = self._run_stage("index", self._stage_index, it)
+            if pair is None:
+                return
+            idx, mask = pair
+            images, labels = self._run_stage("gather", self._stage_gather, idx)
+            images, labels = self._run_stage(
+                "augment", self._stage_augment, images, labels
+            )
+            batch = self._run_stage(
+                "collate", self._stage_collate, images, labels, mask
+            )
+            batch = self._run_stage("shard", self._stage_shard, batch)
             self.telemetry.count("loader/batches")
             yield batch
 

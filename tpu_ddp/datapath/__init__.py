@@ -1,0 +1,72 @@
+"""Data-path observatory: the input plane's measure/model/attribute/
+alert/forensicate stack (the PR 16 comms mold applied to the loader).
+
+Until now the input pipeline was a single opaque ``data_wait`` span: a
+DWT001 firing could hand you a host stack sample, never a stage, a
+rate, or a baseline. This package decomposes the loader into named
+stages, each observable four ways:
+
+- **Live spans + gauges** — ``data/<stage>`` spans nest inside the
+  Trainer's ``data_wait`` (sync path), so ``tpu-ddp trace summarize``
+  and ``tpu-ddp data report`` decompose the wait into a per-stage
+  verdict; :class:`~tpu_ddp.datapath.stages.StageMonitor` keeps a
+  ``data-health-p<i>.json`` file fresh for the fleet aggregator
+  (``tpu-ddp watch``) and the DAT001 stage-throughput-collapse alert.
+- **Determinism audit** — a seeded per-step batch-content digest lands
+  in the incarnation-stamped ``data-p<i>.i<k>.jsonl`` sink;
+  ``tpu-ddp data audit`` verifies that replayed steps across a
+  kill→resume (or an elastic re-mesh at held global batch) reproduce
+  the prior life's digests, fail-closed with the diverging step named.
+- **Measured baselines** — ``tpu-ddp data bench`` microbenchmarks each
+  stage standalone into a schema-versioned kind-"data" registry
+  artifact that ``bench compare`` gates and DAT001 baselines against.
+- **Pricing** — ``tpu-ddp tune --data-from <artifact>`` prices an
+  input-bound floor per candidate and names ``input_bound`` exclusions
+  the way over-HBM ones are named.
+
+Everything except :mod:`~tpu_ddp.datapath.microbench` is stdlib-only
+(+ numpy for the digest): the audit/report CLIs must run on machines
+that never import jax. See ``docs/data.md``.
+"""
+
+from tpu_ddp.datapath.audit import (
+    DATA_DIGEST_SCHEMA_VERSION,
+    DataDigestWriter,
+    audit_digests,
+    batch_digest,
+    read_digest_files,
+)
+from tpu_ddp.datapath.model import (
+    DATA_SCHEMA_VERSION,
+    DataModel,
+    data_model_from_sources,
+    stage_baselines,
+)
+from tpu_ddp.datapath.stages import (
+    DATA_HEALTH_SCHEMA_VERSION,
+    HOST_STAGES,
+    STAGES,
+    StageMonitor,
+    data_health_file,
+    read_data_health,
+    suspect_stage_from_files,
+)
+
+__all__ = [
+    "DATA_SCHEMA_VERSION",
+    "DATA_DIGEST_SCHEMA_VERSION",
+    "DATA_HEALTH_SCHEMA_VERSION",
+    "STAGES",
+    "HOST_STAGES",
+    "StageMonitor",
+    "DataModel",
+    "data_model_from_sources",
+    "stage_baselines",
+    "DataDigestWriter",
+    "audit_digests",
+    "batch_digest",
+    "read_digest_files",
+    "data_health_file",
+    "read_data_health",
+    "suspect_stage_from_files",
+]

@@ -1,0 +1,133 @@
+"""Opt-in bounded background prefetcher for the staged loader
+(``--prefetch-batches N``).
+
+Runs the *identical* staged ``epoch_batches`` generator on a daemon
+thread into a bounded queue — bit-parity with the synchronous path by
+construction (same index math, same gather, same stage bodies; the
+thread only moves WHEN batches materialize, never WHAT they contain —
+the parity test pins this digest-for-digest).
+
+The queue-depth counters are the signal that distinguishes "loader too
+slow" from "device too fast" (docs/data.md):
+
+- ``datapath/prefetch_occupancy`` — queue depth seen at each get
+  (gauge: last; total/batches gives the average),
+- ``datapath/prefetch_put_wait_total_s`` — producer time blocked on a
+  full queue (device-bound: the loader keeps up),
+- ``datapath/prefetch_get_wait_total_s`` — consumer time blocked on an
+  empty queue (input-bound: the loader is the ceiling).
+
+Stage spans/health reports keep working: the telemetry span stack is
+per-thread and the StageMonitor locks, so the producer thread emits
+``data/<stage>`` evidence exactly like the sync path — including the
+chaos per-stage stall seam, which simply wedges the producer (the
+bounded queue drains, ``data_wait`` grows, DAT001/forensics see it).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterator, Optional
+
+_SENTINEL_DONE = object()
+_PUT_POLL_S = 0.1
+
+
+class BackgroundPrefetcher:
+    """Iterate ``make_iter()`` on a background thread through a bounded
+    queue of ``depth`` batches. Iterable; ``close()`` is idempotent and
+    safe mid-epoch (the producer is told to stop and the queue is
+    drained so it can observe the stop flag)."""
+
+    def __init__(
+        self,
+        make_iter: Callable[[], Iterator[Any]],
+        *,
+        depth: int,
+        telemetry: Any = None,
+    ) -> None:
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.depth = int(depth)
+        self._telemetry = telemetry
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._put_wait_total = 0.0
+        self._get_wait_total = 0.0
+        self._occupancy_total = 0.0
+        self._gets = 0
+        self._thread = threading.Thread(
+            target=self._produce, args=(make_iter,),
+            name="tpu-ddp-data-prefetch", daemon=True,
+        )
+        self._thread.start()
+
+    # -- producer ------------------------------------------------------
+
+    def _put(self, item: Any) -> bool:
+        t0 = time.perf_counter()
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=_PUT_POLL_S)
+            except queue.Full:
+                continue
+            self._put_wait_total += time.perf_counter() - t0
+            return True
+        return False
+
+    def _produce(self, make_iter: Callable[[], Iterator[Any]]) -> None:
+        try:
+            for item in make_iter():
+                if not self._put(item):
+                    return
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # surfaced at the consumer's next get
+            self._put(e)
+            return
+        self._put(_SENTINEL_DONE)
+
+    # -- consumer ------------------------------------------------------
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        t0 = time.perf_counter()
+        self._occupancy_total += self._q.qsize()
+        item = self._q.get()
+        self._get_wait_total += time.perf_counter() - t0
+        self._gets += 1
+        self._emit_gauges()
+        if item is _SENTINEL_DONE:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def _emit_gauges(self) -> None:
+        tel = self._telemetry
+        if tel is None:
+            return
+        tel.gauge("datapath/prefetch_occupancy").set(
+            self._occupancy_total / max(self._gets, 1)
+        )
+        tel.gauge("datapath/prefetch_put_wait_total_s").set(
+            round(self._put_wait_total, 6)
+        )
+        tel.gauge("datapath/prefetch_get_wait_total_s").set(
+            round(self._get_wait_total, 6)
+        )
+
+    def close(self) -> None:
+        self._stop.set()
+        # drain so a producer blocked in put() can see the stop flag
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
+        self._emit_gauges()

@@ -83,6 +83,23 @@ def ledger_json(ledger: RunLedger,
     }
 
 
+def _data_wait_note(run_dir: str) -> str:
+    """The ``data_wait`` row's pointer from *how much* input wait to
+    *which stage* to fix: when the run carries staged datapath spans
+    (docs/data.md), name the dominant stage inline so the badput table
+    hands off straight to ``tpu-ddp data report``."""
+    try:
+        from tpu_ddp.datapath.report import datapath_measured
+
+        measured = datapath_measured(run_dir)
+    except (FileNotFoundError, ValueError, OSError):
+        return ""
+    stage = (measured or {}).get("dominant_stage")
+    if not stage:
+        return ""
+    return f"  <- dominant stage: {stage} (tpu-ddp data report)"
+
+
 def _fmt_s(v: Optional[float]) -> str:
     if not isinstance(v, (int, float)):
         return "-"
@@ -172,7 +189,9 @@ def render_ledger(ledger: RunLedger,
         if secs <= 1e-9 and cat.name != "productive":
             continue
         share = secs / ledger.elapsed_s if ledger.elapsed_s else 0.0
-        lines.append(f"{cat.title:<38} {secs:>9.2f} {share:>7.1%}")
+        note = (_data_wait_note(ledger.run_dir)
+                if cat.name == "data_wait" and secs > 1e-9 else "")
+        lines.append(f"{cat.title:<38} {secs:>9.2f} {share:>7.1%}{note}")
     lines.append("-" * len(header))
     total_share = total / ledger.elapsed_s if ledger.elapsed_s else 0.0
     lines.append(f"{'total (= elapsed wall-clock)':<38} {total:>9.2f} "

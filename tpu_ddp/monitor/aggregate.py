@@ -117,6 +117,33 @@ class MonitorConfig:
                                            # bandwidth below this fraction
                                            # of its calibrated baseline
                                            # fires
+    data_baseline: Optional[str] = None    # DAT001: path to a `data
+                                           # bench --json` artifact — the
+                                           # benched per-stage throughput
+                                           # the live data-health files
+                                           # are judged against (None
+                                           # disables the rule; it only
+                                           # fires where a run used the
+                                           # staged pipeline,
+                                           # --prefetch-batches N or
+                                           # --prefetch-depth 0)
+    data_collapse_frac: float = 0.25       # DAT001: a host stage's
+                                           # staleness-adjusted live
+                                           # batches/s below this fraction
+                                           # of its benched baseline fires
+    data_min_stage_s: float = 0.005        # DAT001 materiality floor: a
+                                           # stage only alarms when its
+                                           # live busy cost also exceeds
+                                           # this many seconds per batch.
+                                           # Micro-stages bench in the
+                                           # sub-microsecond range, so
+                                           # per-batch observer overhead
+                                           # (span write + health
+                                           # bookkeeping) alone would
+                                           # mimic a ratio collapse there;
+                                           # an immaterial stage cannot be
+                                           # the input bottleneck. 0
+                                           # disables the floor.
 
     def validate(self) -> "MonitorConfig":
         if self.window < 8:
@@ -152,6 +179,14 @@ class MonitorConfig:
             raise ValueError(
                 f"comms_collapse_frac must be in (0, 1], got "
                 f"{self.comms_collapse_frac}")
+        if not 0.0 < self.data_collapse_frac <= 1.0:
+            raise ValueError(
+                f"data_collapse_frac must be in (0, 1], got "
+                f"{self.data_collapse_frac}")
+        if self.data_min_stage_s < 0:
+            raise ValueError(
+                f"data_min_stage_s must be >= 0 (0 disables the "
+                f"materiality floor), got {self.data_min_stage_s}")
         return self
 
 
@@ -252,6 +287,7 @@ class HostSnapshot:
     health: Dict[str, object] = dataclasses.field(default_factory=dict)
     memory: Dict[str, object] = dataclasses.field(default_factory=dict)
     comms: Dict[str, object] = dataclasses.field(default_factory=dict)
+    datapath: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -468,6 +504,44 @@ def comms_host_view(rec: Optional[dict],
     }
 
 
+def datapath_host_view(rec: Optional[dict],
+                       now: float) -> Dict[str, object]:
+    """One host's ``data-health-p<i>.json`` record (the StageMonitor's
+    live file, docs/data.md) folded for the snapshot. The per-stage
+    rate is BUSY-based — batches per second of time the stage actually
+    ran — because that is the quantity ``data bench`` baselines: a
+    demand-driven loader idles between batches while the device steps,
+    so a wall-clock rate would sit far below any benched rate on every
+    healthy run. A genuinely slow stage balloons its measured busy
+    seconds (the chaos stall seam is inside the measured region) and
+    the busy rate collapses — the DAT001 signal; the in-flight marker
+    rides along to name a currently-wedged stage."""
+    if not isinstance(rec, dict):
+        return {}
+    upd = rec.get("updated_unix")
+    age = (max(now - upd, 0.0)
+           if isinstance(upd, (int, float)) else None)
+    in_flight = rec.get("in_flight")
+    stage_rate: Dict[str, float] = {}
+    for stage, win in (rec.get("stages") or {}).items():
+        if not isinstance(win, dict):
+            continue
+        batches = win.get("batches_window")
+        busy = win.get("busy_s_window")
+        if not isinstance(batches, (int, float)) or not isinstance(
+                busy, (int, float)):
+            continue
+        stage_rate[stage] = float(batches) / max(float(busy), 1e-9)
+    if not stage_rate and not in_flight:
+        return {}
+    return {
+        "stage_batches_per_s": stage_rate,
+        "in_flight": in_flight,
+        "step": rec.get("step"),
+        "age_s": age,
+    }
+
+
 def _per_host(run_dir: str, pattern: str) -> Dict[int, str]:
     """{process_index: path} for a per-host file family in a run dir.
 
@@ -546,6 +620,13 @@ class FleetAggregator:
             if view:
                 comms_views[pid] = view
                 self._host(pid)  # so is a comms-health file
+        datapath_views: Dict[int, Dict[str, object]] = {}
+        for pid, path in _per_host(
+                self.run_dir, "data-health-p*.json").items():
+            view = datapath_host_view(_read_json(path), now)
+            if view:
+                datapath_views[pid] = view
+                self._host(pid)  # and a data-health file
 
         cfg = self.config
         hosts: List[HostSnapshot] = []
@@ -614,6 +695,10 @@ class FleetAggregator:
                 # (staleness-adjusted, docs/comms.md) — COM001's input;
                 # empty unless the run was started with --comms-monitor
                 comms=comms_views.get(pid, {}),
+                # the StageMonitor's live per-stage loader throughput
+                # (staleness-adjusted, docs/data.md) — DAT001's input;
+                # empty unless the run used the staged pipeline
+                datapath=datapath_views.get(pid, {}),
             ))
 
         for phase in ("compiled_step", "data_wait"):

@@ -82,9 +82,10 @@ ALERT_RULES: Dict[str, Dict[str, str]] = {
         "title": "data-wait share high",
         "severity": "warning",
         "kind": "threshold",
-        "fix": "the step loop is input-bound: raise --prefetch-depth, "
-               "check the data filesystem, or move decode work off the "
-               "trainer hosts",
+        "fix": "the step loop is input-bound: run `tpu-ddp data report "
+               "<run_dir>` for the per-stage decomposition of the wait "
+               "(docs/data.md), then raise --prefetch-batches, fix the "
+               "named stage, or move decode work off the trainer hosts",
     },
     "NUM001": {
         "title": "grad-norm spike",
@@ -125,6 +126,18 @@ ALERT_RULES: Dict[str, Dict[str, str]] = {
                "named in the message and the ICI/DCN path under it; if "
                "the ring is fully wedged the watchdog's hang bundle "
                "will name the suspect collective (docs/comms.md)",
+    },
+    "DAT001": {
+        "title": "loader stage throughput collapse",
+        "severity": "warning",
+        "kind": "threshold",
+        "fix": "a host's live staged-loader stage busy-rate (batches "
+               "per second of stage run time, data-health-p<i>.json) "
+               "fell below the collapse fraction of its benched "
+               "baseline (`tpu-ddp data bench`): check the stage named "
+               "in the message (a currently-wedged stage is also named "
+               "in_flight); if the step fully stalls the watchdog's "
+               "hang bundle will carry suspect_stage (docs/data.md)",
     },
     "TRN001": {
         "title": "loss plateau",
@@ -233,6 +246,22 @@ class AlertEngine:
                 self._comms_baselines = axis_baselines(
                     art.get("comms") if isinstance(art.get("comms"), dict)
                     else art)
+        # DAT001's benched per-stage throughput reference, same contract
+        # as the comms baseline above ({} = rule disabled)
+        self._data_baselines: Dict[str, float] = {}
+        if self.config.data_baseline:
+            try:
+                with open(self.config.data_baseline) as f:
+                    art = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                log.warning(
+                    "DAT001 disabled: could not read the data baseline "
+                    "artifact at %r", self.config.data_baseline)
+                art = None
+            if isinstance(art, dict):
+                from tpu_ddp.datapath.model import stage_baselines
+
+                self._data_baselines = stage_baselines(art)
 
     # -- rule evaluation --------------------------------------------------
 
@@ -342,6 +371,42 @@ class AlertEngine:
                         f"host {h.host} axis {axis!r} measured "
                         f"{eff:.3g} B/s vs calibrated {base:.3g} B/s "
                         f"(< {cfg.comms_collapse_frac:.0%})"
+                        + stuck,
+                        eff,
+                    )
+
+            # DAT001: live measured per-stage loader throughput (the
+            # StageMonitor's health file, staleness-adjusted by the
+            # aggregator) against the benched baseline. Worst offending
+            # stage names the message; the in-flight stage rides along —
+            # it is the hang forensics' suspect_stage.
+            if self._data_baselines and h.datapath:
+                worst = None  # (stage, eff, base)
+                rates = h.datapath.get("stage_batches_per_s") or {}
+                for stage, eff in rates.items():
+                    base = self._data_baselines.get(stage)
+                    if not (base and isinstance(eff, (int, float))):
+                        continue
+                    # materiality floor: sub-millisecond benched stages
+                    # fail the ratio test on observer overhead alone; a
+                    # stage whose live busy cost is under the floor
+                    # cannot be the input bottleneck, whatever its ratio
+                    if eff * cfg.data_min_stage_s > 1.0:
+                        continue
+                    if (eff < cfg.data_collapse_frac * base
+                            and (worst is None
+                                 or eff / base < worst[1] / worst[2])):
+                        worst = (stage, float(eff), base)
+                if worst is not None:
+                    stage, eff, base = worst
+                    flight = h.datapath.get("in_flight") or {}
+                    stuck = (f"; in flight: {flight.get('stage')} "
+                             f"since step {flight.get('step')}"
+                             if flight.get("stage") else "")
+                    found[("DAT001", h.host)] = (
+                        f"host {h.host} loader stage {stage!r} measured "
+                        f"{eff:.3g} batches/s vs benched {base:.3g} "
+                        f"batches/s (< {cfg.data_collapse_frac:.0%})"
                         + stuck,
                         eff,
                     )

@@ -226,6 +226,11 @@ def render_report(report: dict) -> str:
         health = h.get("health") or {}
         if health.get("nonfinite_steps"):
             flags.append(f"nonfinite×{health['nonfinite_steps']}")
+        # a loader stage currently wedged on this host (the
+        # StageMonitor's in-flight marker — DAT001's suspect)
+        flight = (h.get("datapath") or {}).get("in_flight") or {}
+        if flight.get("stage"):
+            flags.append(f"stage:{flight['stage']}")
         rate = h.get("steps_per_sec")
         share = h.get("data_wait_share")
         lines.append(
@@ -360,6 +365,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     metavar="FRACTION",
                     help="COM001 threshold as a fraction of the "
                          "calibrated baseline bandwidth")
+    ap.add_argument("--data-baseline", default=None, metavar="FILE",
+                    help="`tpu-ddp data bench --json` artifact: DAT001 "
+                         "fires when a host's live staged-loader stage "
+                         "busy rate (batches per second of stage run "
+                         "time, data-health-p<i>.json) falls below "
+                         "--data-collapse-frac of its benched per-stage "
+                         "baseline (docs/data.md; needs a run on the "
+                         "staged pipeline, --prefetch-batches N or "
+                         "--prefetch-depth 0)")
+    ap.add_argument("--data-collapse-frac", type=float, default=0.25,
+                    metavar="FRACTION",
+                    help="DAT001 threshold as a fraction of the benched "
+                         "baseline stage throughput")
+    ap.add_argument("--data-min-stage-s", type=float, default=0.005,
+                    metavar="SECONDS",
+                    help="DAT001 materiality floor: a stage only alarms "
+                         "when its live busy cost also exceeds this many "
+                         "seconds per batch (micro-stages bench in the "
+                         "sub-microsecond range, where observer overhead "
+                         "alone would mimic a ratio collapse; 0 "
+                         "disables)")
     ap.add_argument("--webhook", default=None, metavar="URL",
                     help="also POST every alert edge as JSON here")
     ap.add_argument("--no-alerts-file", action="store_true",
@@ -395,6 +421,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         max_auto_profiles=args.max_auto_profiles,
         comms_baseline=args.comms_baseline,
         comms_collapse_frac=args.comms_collapse_frac,
+        data_baseline=args.data_baseline,
+        data_collapse_frac=args.data_collapse_frac,
+        data_min_stage_s=args.data_min_stage_s,
     )
     actions = ["log"] if args.json else []
     if not args.no_alerts_file:

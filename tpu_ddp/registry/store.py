@@ -193,6 +193,11 @@ def _artifact_kind(art: dict) -> str:
         # (docs/comms.md) — must outrank the bare "rows" fallback below
         # (the comms record carries a per-link rows trend channel too)
         return "comms"
+    if "data_schema_version" in art or art.get("type") == "data":
+        # `tpu-ddp data bench --json`: the measured loader-stage model
+        # (docs/data.md) — also outranks the "rows" fallback (its record
+        # carries a per-stage rows trend channel)
+        return "data"
     if "images_per_sec_per_chip" in art or "vs_baseline" in art \
             or "rows" in art:
         return "bench"

@@ -405,6 +405,15 @@ def summarize(path: str) -> str:
     if measured:
         lines.append("")
         lines.extend(measured)
+    from tpu_ddp.datapath.report import (
+        datapath_measured,
+        format_datapath_measured,
+    )
+
+    data_block = format_datapath_measured(datapath_measured(path))
+    if data_block:
+        lines.append("")
+        lines.extend(data_block)
     return "\n".join(lines)
 
 
@@ -464,4 +473,14 @@ def summarize_json(path: str) -> dict:
         # measured comms evidence (exposure record + hop-monitor health;
         # docs/comms.md) — None when the run left none
         "comms": comms_measured(path) or None,
+        # measured data-path evidence (staged data/<stage> spans +
+        # prefetch queue counters; docs/data.md) — None when the run
+        # never ran the staged pipeline
+        "datapath": _datapath_measured(path) or None,
     }
+
+
+def _datapath_measured(path: str) -> dict:
+    from tpu_ddp.datapath.report import datapath_measured
+
+    return datapath_measured(path)

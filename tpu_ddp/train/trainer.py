@@ -138,6 +138,23 @@ class TrainConfig:
     prefetch_depth: int = 2               # >0: assemble batches ahead on the
                                           # native host prefetcher (C++ ring
                                           # buffer; 0 disables)
+    prefetch_batches: int = 0             # >0: run the STAGED loader
+                                          # pipeline (index/gather/augment/
+                                          # collate/shard, per-stage spans
+                                          # + data-health attribution) on a
+                                          # background thread into a
+                                          # bounded queue of N batches —
+                                          # the datapath observatory's
+                                          # prefetcher (docs/data.md).
+                                          # Bit-identical batches to the
+                                          # synchronous path; takes
+                                          # precedence over prefetch_depth
+    data_digests: bool = True             # record the per-step batch-
+                                          # content digest into the
+                                          # data-p<i>.i<k>.jsonl sink for
+                                          # `tpu-ddp data audit` (active
+                                          # exactly when telemetry_dir is
+                                          # set; docs/data.md)
     remat: bool = False                   # jax.checkpoint the forward:
                                           # trade FLOPs for HBM on big models
     model: str = "netresdeep"
@@ -413,6 +430,22 @@ class TrainConfig:
                     "per-hop callback seam, so without the monitor the "
                     "fault can never trigger"
                 )
+            if any(f.get("kind") == "data_stall" and f.get("stage")
+                   for f in spec["faults"]) \
+                    and self.prefetch_depth > 0 \
+                    and self.prefetch_batches <= 0:
+                raise ValueError(
+                    "chaos spec contains a stage-targeted data_stall "
+                    "fault but the staged loader pipeline is off: the "
+                    "stall fires from the per-stage observer seam, which "
+                    "runs only with --prefetch-batches N or "
+                    "--prefetch-depth 0"
+                )
+        if self.prefetch_batches < 0:
+            raise ValueError(
+                f"prefetch_batches must be >= 0 (0 disables the staged "
+                f"background prefetcher), got {self.prefetch_batches}"
+            )
         if self.zero1 and self.optimizer == "lamb":
             raise ValueError(
                 "--zero1 does not compose with --optimizer lamb (the "
@@ -768,6 +801,40 @@ class Trainer:
                 devices=[d for d in devices if d in local],
             )
 
+        # Data-path observatory (docs/data.md): the per-stage loader
+        # observer keeps data-health-p<i>.json fresh for the fleet
+        # aggregator / DAT001 and carries the chaos per-stage stall seam;
+        # the digest writer records each step's batch-content digest into
+        # the incarnation-stamped data-p<i>.i<k>.jsonl sink for the
+        # determinism audit. Both exist exactly when telemetry does, and
+        # must be built BEFORE _load_data so the train loader is born
+        # with its observer attached.
+        self._datapath = None
+        self._data_digests = None
+        if config.telemetry_dir:
+            from tpu_ddp.datapath.stages import StageMonitor
+
+            self._datapath = StageMonitor(
+                config.telemetry_dir,
+                process_index=self.process_index,
+                stall_hook=(
+                    self._chaos.data_stall_hook
+                    if self._chaos is not None else None
+                ),
+                telemetry=self.telemetry,
+            )
+            if config.data_digests:
+                from tpu_ddp.datapath.audit import DataDigestWriter
+
+                self._data_digests = DataDigestWriter(
+                    config.telemetry_dir,
+                    process_index=self.process_index,
+                    incarnation=self.incarnation,
+                    seed=config.seed,
+                    run_id=self.run_meta.get("run_id"),
+                    global_batch=config.per_shard_batch * self.data_size,
+                )
+        self._data_prefetcher = None  # staged background prefetcher
         self.model = build_model(config)
         self._load_data(train_data, test_data)
         total_steps = self.train_loader.steps_per_epoch * config.epochs
@@ -1256,6 +1323,7 @@ class Trainer:
             process_index=self.process_index,
             process_count=self.process_count,
             telemetry=self.telemetry,
+            observer=self._datapath,
         )
         if c.loss == "bce" and np.asarray(train[1]).ndim != 2:
             raise ValueError(
@@ -1306,9 +1374,30 @@ class Trainer:
         throughput accounting never forces a device sync).
 
         With ``prefetch_depth > 0`` batches assemble ahead of consumption on
-        the host prefetcher (native C++ ring when available)."""
+        the host prefetcher (native C++ ring when available); with
+        ``prefetch_batches > 0`` the STAGED loader pipeline (per-stage
+        spans + data-health attribution, docs/data.md) runs ahead on a
+        background thread instead — bit-identical batches, and it takes
+        precedence over the native prefetcher."""
         K = self.steps_per_call if self.multi_step is not None else 1
         depth = self.config.prefetch_depth
+        if self.config.prefetch_batches > 0:
+            from tpu_ddp.datapath.prefetch import BackgroundPrefetcher
+
+            if self._data_prefetcher is not None:
+                self._data_prefetcher.close()
+            pf = BackgroundPrefetcher(
+                self._digested_batches,
+                depth=self.config.prefetch_batches,
+                telemetry=self.telemetry,
+            )
+            self._data_prefetcher = pf
+            try:
+                yield from self._host_batch_stream(iter(pf), K)
+            finally:
+                pf.close()
+                self._data_prefetcher = None
+            return
         if depth > 0:
             if self._prefetcher is None:
                 from tpu_ddp.native.prefetch import BatchPrefetcher
@@ -1322,9 +1411,30 @@ class Trainer:
                 )
             yield from self._prefetched_stream(K, depth)
             return
+        yield from self._host_batch_stream(self._digested_batches(), K)
+
+    def _digested_batches(self):
+        """The train loader's staged epoch stream, with each batch's
+        content digest recorded against its GLOBAL step number (epochs
+        are 1-based; batch j of epoch E is step (E-1)*steps_per_epoch+j)
+        — the determinism audit's evidence (docs/data.md). Runs on the
+        producer thread under --prefetch-batches; digest cost rides the
+        pipeline, not the step loop."""
+        loader = self.train_loader
+        base = (max(loader._epoch, 1) - 1) * loader.steps_per_epoch
+        # iterator protocol, not epoch_batches(): the loader attribute may
+        # be wrapped (fault-injection shims override __iter__ only)
+        for i, batch in enumerate(loader):
+            if self._data_digests is not None:
+                self._data_digests.record(base + i, batch)
+            yield batch
+
+    def _host_batch_stream(self, it, K: int):
+        """The consuming half of the synchronous/staged-prefetch paths:
+        draw host batches from ``it`` (``data_wait``), device_put them
+        (``h2d``), fusing K-step groups into stacked submissions."""
         tel = self.telemetry
         if K <= 1:
-            it = iter(self.train_loader)
             while True:
                 with tel.span("data_wait"):
                     batch = next(it, None)
@@ -1334,7 +1444,6 @@ class Trainer:
                     dev = self._put(batch)
                 yield "single", dev, int(batch["mask"].sum())
         pending = []
-        it = iter(self.train_loader)
         while True:
             with tel.span("data_wait"):
                 batch = next(it, None)
@@ -1390,10 +1499,12 @@ class Trainer:
         host_copy = pf.reusable_slots and not real_h2d
 
         def submissions():
+            seq = 0  # batch index within the epoch (digest step anchors)
             buf_idx, buf_masks = [], []
             for idx, mask in loader.epoch_index_batches():
                 if K <= 1:
-                    yield "single", idx, mask
+                    yield "single", idx, mask, seq
+                    seq += 1
                     continue
                 buf_idx.append(idx)
                 buf_masks.append(mask)
@@ -1402,17 +1513,21 @@ class Trainer:
                         "stacked",
                         np.concatenate(buf_idx),
                         np.stack(buf_masks),
+                        seq,
                     )
+                    seq += K
                     buf_idx, buf_masks = [], []
             for idx, mask in zip(buf_idx, buf_masks):
-                yield "single", idx, mask
+                yield "single", idx, mask, seq
+                seq += 1
 
         in_flight = deque()
 
         tel = self.telemetry
+        step_base = (max(loader._epoch, 1) - 1) * loader.steps_per_epoch
 
         def emit():
-            kind, mask = in_flight.popleft()
+            kind, mask, seq = in_flight.popleft()
             with tel.span("data_wait"):
                 # blocks until the prefetcher finishes the oldest gather
                 img, lbl, slot = pf.acquire()  # FIFO: matches oldest submission
@@ -1431,12 +1546,25 @@ class Trainer:
                 # Fence ONLY the H2D transfer, then recycle the slot; the
                 # copy of batch N+depth overlaps the device computing batch N.
                 jax.block_until_ready(dev)
+            dw = self._data_digests
+            if dw is not None:
+                # digest BEFORE the slot recycles (img/lbl may alias it)
+                if kind == "stacked":
+                    for k in range(K):
+                        dw.record(step_base + seq + k, {
+                            "image": img[k], "label": lbl[k],
+                            "mask": mask[k],
+                        })
+                else:
+                    dw.record(step_base + seq, {
+                        "image": img, "label": lbl, "mask": mask,
+                    })
             pf.release(slot)
             return kind, dev, int(mask.sum())
 
-        for kind, idx, mask in submissions():
+        for kind, idx, mask, seq in submissions():
             pf.submit(idx)
-            in_flight.append((kind, mask))
+            in_flight.append((kind, mask, seq))
             if len(in_flight) > depth:
                 yield emit()
         while in_flight:
@@ -1451,6 +1579,13 @@ class Trainer:
         if self._prefetcher is not None:
             self._prefetcher.close()
             self._prefetcher = None
+        if self._data_prefetcher is not None:
+            self._data_prefetcher.close()
+            self._data_prefetcher = None
+        if self._datapath is not None:
+            self._datapath.close()
+        if self._data_digests is not None:
+            self._data_digests.close()
         if self._exporter is not None:
             self._exporter.close()
             self._exporter = None
@@ -1777,12 +1912,13 @@ class Trainer:
             from tpu_ddp.telemetry import HangWatchdog
 
             on_hang = None
-            if self._comms_monitor is not None and c.telemetry_dir:
-                # stuck-collective forensics (docs/comms.md): join the
-                # stack dump with the last comms-health record so the
-                # hang bundle NAMES the suspect collective — written
-                # before the abort escalation, because after it there
-                # is no process left to ask
+            if c.telemetry_dir:
+                # hang forensics (docs/comms.md, docs/data.md): join the
+                # stack dump with the last comms-health and data-health
+                # records so the hang bundle NAMES the suspect collective
+                # and/or the suspect loader stage — written before the
+                # abort escalation, because after it there is no process
+                # left to ask
                 from tpu_ddp.comms.forensics import write_hang_bundle
 
                 run_dir = c.telemetry_dir
@@ -1884,6 +2020,7 @@ class Trainer:
                 or self._memtrack is not None
                 or self._chaos is not None
                 or self._comms_monitor is not None
+                or self._datapath is not None
                 or (self.checkpointer is not None
                     and c.checkpoint_steps > 0)
             )
@@ -1963,6 +2100,10 @@ class Trainer:
                     # stamp the host step onto subsequent hop records so
                     # the hang forensics can say WHEN the ring wedged
                     self._comms_monitor.set_step(host_step)
+                if self._datapath is not None:
+                    # same stamp for data-health records: the in-flight
+                    # stage marker names the step a stall wedged on
+                    self._datapath.set_step(host_step)
                 if self._capture is not None:
                     # capture-window lifecycle: opens an armed window when
                     # its start step arrives, closes + writes the bundle

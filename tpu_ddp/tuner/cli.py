@@ -159,7 +159,8 @@ def render_result(result, *, top: int = 0) -> str:
         f"[{result.calibration_source}], hbm "
         f"x{result.hbm_calibration_ratio:g} "
         f"[{result.hbm_calibration_source}], comms "
-        f"[{result.comms_calibration_source}])",
+        f"[{result.comms_calibration_source}], data "
+        f"[{result.data_calibration_source}])",
         "",
     ]
     rows = result.ranked[:top] if top else result.ranked
@@ -217,6 +218,7 @@ def tune_artifact(result) -> dict:
         "hbm_calibration": {"ratio": result.hbm_calibration_ratio,
                             "source": result.hbm_calibration_source},
         "comms_calibration": {"source": result.comms_calibration_source},
+        "data_calibration": {"source": result.data_calibration_source},
         "grid": result.grid_descriptor(),
         "n_candidates": len(result.ranked) + len(result.excluded),
         "n_ranked": len(result.ranked),
@@ -327,6 +329,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "ignored; docs/comms.md). With measured comms "
                          "evidence, peak-less chips (cpu) price on the "
                          "comm term alone")
+    ap.add_argument("--data-from", action="append", default=[],
+                    metavar="PATH", dest="data_from",
+                    help="`tpu-ddp data bench --json` artifact whose "
+                         "benched per-image host cost prices each "
+                         "candidate's input-bound floor (repeatable; "
+                         "docs/data.md). Candidates the loader cannot "
+                         "feed are excluded input_bound, named like "
+                         "over_hbm exclusions")
     ap.add_argument("--registry", default=None, metavar="DIR",
                     help="perf-registry workspace: archived validated "
                          "tune entries join the time calibration, "
@@ -384,6 +394,13 @@ def _run(args) -> int:
 
     comms_model = comms_model_for_chip(
         chip, sources=args.comms_from, registry_dir=args.registry)
+    # measured input-cost model (docs/data.md): `data bench` artifacts
+    # + data-kind registry entries; with evidence, every candidate gets
+    # an input-bound floor and unfeedable ones are excluded by name
+    from tpu_ddp.datapath.model import data_model_from_sources
+
+    data_model = data_model_from_sources(
+        args.data_from, registry_dir=args.registry)
     if spec is None or (spec.peak_bf16_flops is None
                         and not comms_model):
         raise ValueError(
@@ -441,6 +458,9 @@ def _run(args) -> int:
         comms_model=comms_model or None,
         comms_calibration_source=comms_model.source
         if comms_model else "none",
+        data_model=data_model or None,
+        data_calibration_source=data_model.source
+        if data_model else "none",
         dispatch_overhead_s=(
             args.dispatch_overhead_us * 1e-6
             if args.dispatch_overhead_us is not None

@@ -59,6 +59,7 @@ STATUS_OVER_HBM = "over_hbm"
 STATUS_LINT = "lint"
 STATUS_COMPILE_ERROR = "compile_error"
 STATUS_UNPRICEABLE = "unpriceable"
+STATUS_INPUT_BOUND = "input_bound"
 
 
 @dataclasses.dataclass
@@ -79,6 +80,7 @@ class PricedCandidate:
     lint_rule_counts: Dict[str, int] = dataclasses.field(
         default_factory=dict)
     measured: Optional[dict] = None           # --validate-top join
+    input_floor_s: Optional[float] = None     # --data-from measured floor
 
     @property
     def predicted_step_us(self) -> Optional[int]:
@@ -110,6 +112,8 @@ class PricedCandidate:
             rec["lint_rule_counts"] = self.lint_rule_counts
         if self.measured is not None:
             rec["measured"] = self.measured
+        if self.input_floor_s is not None:
+            rec["input_floor_us"] = int(round(self.input_floor_s * 1e6))
         return rec
 
 
@@ -138,6 +142,10 @@ class TuneResult:
     # `--comms-from` evidence whose α-β link model replaced the
     # spec-sheet ICI term in every candidate's roofline
     comms_calibration_source: str = "none"
+    # measured input-cost calibration (docs/data.md): names the
+    # `--data-from` evidence whose per-image host cost priced every
+    # candidate's input-bound floor
+    data_calibration_source: str = "none"
 
     @property
     def winner(self) -> Optional[PricedCandidate]:
@@ -162,6 +170,7 @@ class TuneResult:
             "calibration_ratio": self.calibration_ratio,
             "hbm_calibration_ratio": self.hbm_calibration_ratio,
             "comms_calibration_source": self.comms_calibration_source,
+            "data_calibration_source": self.data_calibration_source,
         }
 
 
@@ -248,6 +257,7 @@ def price_anatomy(
     lint_rule_counts: Optional[Dict[str, int]] = None,
     lint_errors: Sequence[str] = (),
     comms_model=None,
+    data_model=None,
 ) -> PricedCandidate:
     """The pure pricing tail over an already-extracted anatomy: lint
     verdict -> HBM cap -> roofline -> calibration -> dispatch
@@ -263,7 +273,16 @@ def price_anatomy(
     ``comms_model`` (a ``comms/model.py`` LinkModel with evidence)
     swaps the roofline's spec-sheet ICI term for measured per-link α-β
     pricing — and unlocks peak-less chips (CPU hosts): their price is
-    comm-term-only, honest about what was measured."""
+    comm-term-only, honest about what was measured.
+
+    ``data_model`` (a ``datapath/model.py`` DataModel with evidence,
+    ``--data-from``) prices a measured INPUT-BOUND floor per candidate:
+    the host must produce ``per_shard_batch * data_axis`` images per
+    step at the benched per-image cost (single-host conservative — a
+    symmetric pod divides the load by its host count), and a candidate
+    whose floor exceeds its compute-side step cannot be fed — it is
+    excluded ``input_bound``, named like an ``over_hbm`` exclusion
+    (docs/data.md)."""
     from tpu_ddp.analysis.roofline import chip_spec, roofline
 
     name = cand.name(n_devices)
@@ -315,6 +334,29 @@ def price_anatomy(
     effective = (rl.predicted_step_s * calibration_ratio
                  + dispatch_overhead_s / max(cand.steps_per_call, 1))
     data = cand.mesh_sizes(n_devices).get("data", 1)
+    input_floor = None
+    if data_model:
+        images_per_step = cand.per_shard_batch * data
+        input_floor = data_model.input_floor_s(images_per_step)
+        if input_floor > effective:
+            dominant = (f"; dominant stage: {data_model.dominant_stage}"
+                        if data_model.dominant_stage else "")
+            return PricedCandidate(
+                candidate=cand, name=name, status=STATUS_INPUT_BOUND,
+                reason=(f"measured input floor "
+                        f"{input_floor * 1e6:.0f} us/step "
+                        f"({images_per_step} images x "
+                        f"{data_model.per_image_s * 1e6:.2f} us/image "
+                        "benched host input cost) exceeds the "
+                        f"{effective * 1e6:.0f} us compute step — the "
+                        f"loader cannot feed this candidate{dominant}"),
+                model_step_s=rl.predicted_step_s,
+                effective_step_s=effective,
+                bound=rl.bound, peak_bytes=peak,
+                hbm_fraction=(round(hbm_fraction, 4)
+                              if hbm_fraction is not None else None),
+                lint_rule_counts=counts, input_floor_s=input_floor,
+            )
     throughput = cand.per_shard_batch * data / n_devices / effective
     return PricedCandidate(
         candidate=cand, name=name, status=STATUS_OK,
@@ -324,7 +366,7 @@ def price_anatomy(
         bound=rl.bound, peak_bytes=peak,
         hbm_fraction=(round(hbm_fraction, 4)
                       if hbm_fraction is not None else None),
-        lint_rule_counts=counts,
+        lint_rule_counts=counts, input_floor_s=input_floor,
     )
 
 
@@ -344,6 +386,8 @@ def tune(
     hbm_calibration_source: str = "none",
     comms_model=None,
     comms_calibration_source: str = "none",
+    data_model=None,
+    data_calibration_source: str = "none",
     dispatch_overhead_s: float = DEFAULT_DISPATCH_OVERHEAD_S,
     overlap: str = "overlapped",
     lint_config=None,
@@ -404,7 +448,7 @@ def tune(
             hbm_calibration_ratio=hbm_calibration_ratio,
             dispatch_overhead_s=dispatch_overhead_s, overlap=overlap,
             lint_rule_counts=rule_counts(findings), lint_errors=errors,
-            comms_model=comms_model,
+            comms_model=comms_model, data_model=data_model,
         )
         (ranked if priced.status == STATUS_OK else excluded).append(priced)
     ranked.sort(key=lambda p: (-p.predicted_images_per_sec_per_chip,
@@ -418,6 +462,7 @@ def tune(
         hbm_calibration_ratio=hbm_calibration_ratio,
         hbm_calibration_source=hbm_calibration_source,
         comms_calibration_source=comms_calibration_source,
+        data_calibration_source=data_calibration_source,
         ranked=ranked, excluded=excluded,
         compiled_programs=len(audits),
         image_size=image_size, overlap=overlap,
