@@ -7,7 +7,7 @@ PYTHON ?= python
 	trace-demo health-demo zero-demo compress-demo analyze-demo \
 	lint-demo monitor-demo profile-demo goodput-demo registry-demo \
 	tune-demo mem-demo curves-demo chaos-demo comms-demo data-demo \
-	bench-compare
+	kernels-demo bench-compare
 
 # Fast default loop (round-3 verdict item 5): skips the `slow`-marked
 # multi-process / end-to-end-CLI / AOT tests. CI and pre-commit should run
@@ -301,6 +301,22 @@ data-demo:
 	rm -rf $(DATA_DEMO_DIR)
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  $(PYTHON) -m tpu_ddp.tools.data_demo --dir $(DATA_DEMO_DIR)
+
+# Fused-kernel tier acceptance (docs/kernels.md): interpret-mode `ops
+# bench` must measure every strategy kernel bit-identical to its jnp
+# reference and registry-record as kind `ops`; `tune --ops-from` must
+# price the kernel switch by its SIGNED measured saving (negative in
+# interpret mode — kernel-off outranks every +krn twin); a full
+# zero1 + int8-ring + error-feedback training run with --kernels must
+# match the XLA path bit for bit (params, moments + EMA, EF
+# residuals); and a deliberately corrupted kernel must fail the
+# parity gate by name with exit 1. Exits nonzero on any miss
+# (tpu_ddp/tools/kernels_demo.py).
+KERNELS_DEMO_DIR ?= /tmp/tpu_ddp_kernels_demo
+kernels-demo:
+	rm -rf $(KERNELS_DEMO_DIR)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+	  $(PYTHON) -m tpu_ddp.tools.kernels_demo --dir $(KERNELS_DEMO_DIR)
 
 # Deviceless perf-regression gate: re-capture the AOT artifact with the
 # real XLA:TPU toolchain (needs libtpu; ~30+ min of compiles) and diff

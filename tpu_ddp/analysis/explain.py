@@ -656,6 +656,19 @@ def render_report(anatomy: StepAnatomy, rl: RooflineReport,
     )
     for note in rl.notes:
         lines.append(f"  note: {note}")
+    from tpu_ddp.ops import kernel_hints
+
+    hints = kernel_hints(anatomy.strategy)
+    if hints:
+        lines.append("")
+        lines.append("kernel candidates (fused Pallas tier, opt-in via "
+                     "--kernels; docs/kernels.md):")
+        for h in hints:
+            avail = ("available" if h["available"]
+                     else "NOT available here (switch fails closed)")
+            lines.append(f"  {h['kernel']:<16} {avail} "
+                         f"[backend: {h['backend'] or 'none'}]")
+            lines.append(f"      fuses: {h['hint']}")
     if fingerprint is not None and fingerprint.get("ok") is not None:
         lines.append("")
         if fingerprint["ok"]:
@@ -768,10 +781,13 @@ def _provenance_for(anatomy, run_meta=None) -> dict:
 
 def _emit(args, anatomy, rl, fp, joined=None, run_meta=None) -> None:
     if getattr(args, "json", None):
+        from tpu_ddp.ops import kernel_hints
+
         payload = {
             "anatomy": anatomy.to_json(),
             "roofline": rl.to_json(),
             "fingerprint": fp,
+            "kernel_candidates": kernel_hints(anatomy.strategy),
             "provenance": _provenance_for(anatomy, run_meta),
         }
         if run_meta is not None:

@@ -39,6 +39,10 @@ renders in docs/lint.md):
 - **RCP001** recompile-hazard AST rule — jit built inside a loop,
   unhashable (mutable) defaults on jitted functions, and wall-clock /
   np.random trace-time constants inside the step factories.
+- **KRN001** fused-kernel capability audit — a config that enables the
+  Pallas kernel switch (``--kernels``) on a backend with no Pallas
+  lowering fails CLOSED: the rule names every fused kernel the switch
+  would silently skip and the XLA reference each falls back to.
 
 ``tpu-ddp lint --strategy all`` verifies all nine strategy programs
 (incl. the ``--zero1`` / ``--grad-compress`` layout overlays) plus the
@@ -102,6 +106,12 @@ RULES: Dict[str, Dict[str, str]] = {
         "fix": "hoist jax.jit out of loops, keep jitted-function "
                "defaults hashable, and bake no wall-clock/np.random "
                "values into traced code",
+    },
+    "KRN001": {
+        "title": "fused-kernel capability audit",
+        "fix": "run with --kernels only where Pallas can execute "
+               "(mosaic on TPU, the interpreter on CPU) — or drop the "
+               "switch and keep the named XLA fallback path explicitly",
     },
 }
 
@@ -660,6 +670,39 @@ def lint_strategy(strategy: str, *, config: Optional[LintConfig] = None,
     )
 
 
+# -- KRN001: fused-kernel capability tier ---------------------------------
+
+def lint_kernels(enabled: bool, *, backend: Any = "auto",
+                 program: str = "kernels") -> List[LintFinding]:
+    """KRN001: audit the fused Pallas kernel switch against the
+    backend's actual capability. ``enabled`` is the config's
+    ``kernels`` switch; ``backend`` is ``tpu_ddp.ops.pallas_backend()``
+    (probed when left at ``"auto"``). A switch that is on where no
+    Pallas lowering exists fails closed — one error per strategy-level
+    kernel, naming the kernel AND the jnp reference it silently falls
+    back to, so an operator never believes a kernel ran that didn't."""
+    if not enabled:
+        return []
+    from tpu_ddp.ops import KERNELS, pallas_backend
+
+    if backend == "auto":
+        backend = pallas_backend()
+    if backend is not None:
+        return []
+    findings: List[LintFinding] = []
+    for name in sorted(KERNELS):
+        entry = KERNELS[name]
+        if not entry["strategies"]:
+            continue  # model-level kernels are not behind this switch
+        findings.append(_finding(
+            "KRN001", program,
+            f"kernel switch is ON but this backend has no Pallas "
+            f"lowering: '{name}' will NOT run — the step silently "
+            f"takes its XLA fallback ({entry['reference']})",
+        ))
+    return findings
+
+
 # -- RCP001: AST tier -----------------------------------------------------
 
 #: CANONICAL module prefixes whose calls bake a different value into
@@ -896,6 +939,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="skip the RCP001 AST tier over tpu_ddp/")
     ap.add_argument("--source-root", default=None,
                     help="RCP001 root (default: the tpu_ddp package)")
+    ap.add_argument("--kernels", action="store_true",
+                    help="audit the fused Pallas kernel switch (KRN001: "
+                         "fails closed where no Pallas lowering exists, "
+                         "naming each skipped kernel and its fallback)")
     args = ap.parse_args(list(argv) if argv is not None else None)
 
     strategies = (list(STRATEGIES) if args.strategy == "all"
@@ -926,6 +973,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             }
             print(render_findings("source (RCP001 AST tier)", src),
                   flush=True)
+        if args.kernels:
+            krn = lint_kernels(True)
+            n_errors += sum(1 for f in krn if f.severity == "error")
+            programs["kernels"] = {
+                "strategy": "kernels",
+                "rule_counts": rule_counts(krn),
+                "findings": [f.to_json() for f in krn],
+            }
+            print(render_findings("kernels (KRN001 capability tier)",
+                                  krn), flush=True)
     except (FileNotFoundError, ValueError) as e:
         print(f"tpu-ddp lint: {e}", flush=True)
         return 2

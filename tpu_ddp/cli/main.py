@@ -80,6 +80,13 @@ Subcommands:
   (non-overlapped) comm share against its comm-stripped twin, and name
   a hung run's suspect collective against the program-order schedule
   (docs/comms.md).
+- ``tpu-ddp ops bench|calibrate`` — the fused-kernel tier: measure
+  each Pallas kernel (``fused_update``, ``fused_quant``,
+  ``fused_dequant``) against its XLA path under jit with an in-bench
+  bit-parity gate (exit 1 names any failing kernel; schema-versioned
+  artifact, registry kind "ops"), and assemble the per-chip kernel
+  cost model ``tune --ops-from`` prices the ``--kernels`` switch with
+  (docs/kernels.md).
 - ``tpu-ddp data bench|audit|report`` — the data-path observatory:
   measure per-stage loader microbenchmarks over the staged input
   pipeline (schema-versioned artifact; registry kind "data", ``bench
@@ -222,6 +229,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_ddp.datapath.cli import main as data_main
 
         return data_main(argv[1:])
+    # ops owns its argparse surface; bench runs the fused kernels (lazy
+    # jax), calibrate stays stdlib-only
+    if argv[:1] == ["ops"]:
+        from tpu_ddp.ops.cli import main as ops_main
+
+        return ops_main(argv[1:])
     if argv[:2] == ["bench", "compare"]:
         from tpu_ddp.analysis.regress import main as compare_main
 
@@ -316,6 +329,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
              "batch-provenance determinism audit across kill/resume and "
              "re-mesh, per-stage data_wait decomposition "
              "(tpu-ddp data --help)",
+    )
+    sub.add_parser(
+        "ops",
+        help="fused-kernel tier: fused-vs-XLA microbenchmarks with a "
+             "bit-parity gate + per-chip kernel cost calibration "
+             "(tpu-ddp ops --help)",
     )
     sub.add_parser(
         "tune",

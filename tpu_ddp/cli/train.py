@@ -99,6 +99,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "it back into the next step's gradient (the "
                         "residual rides the TrainState, is checkpointed, "
                         "and keeps long-run convergence unbiased)")
+    p.add_argument("--kernels", action="store_true",
+                   help="route the DP-family optimizer-update tail and "
+                        "the int8 ring's quantize/dequantize through "
+                        "the fused Pallas kernels (ops/, "
+                        "docs/kernels.md): bit-identical math, one HBM "
+                        "pass instead of the materialized XLA chain. "
+                        "Fails closed per kernel on backends without "
+                        "Pallas support (lint KRN001 reports)")
     p.add_argument("--mesh", default=None, metavar="AXES",
                    help="device mesh axis sizes, e.g. data=2,model=4 "
                         "(axes: data, pipeline, expert, sequence, model; "
@@ -448,6 +456,7 @@ def config_from_args(args) -> TrainConfig:
         grad_compress=args.grad_compress,
         grad_compress_block=args.grad_compress_block,
         grad_compress_error_feedback=args.grad_compress_error_feedback,
+        kernels=args.kernels,
         mesh=mesh_sizes,
         n_microbatches=args.microbatches,
         pp_schedule=args.pp_schedule,

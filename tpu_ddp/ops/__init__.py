@@ -1,12 +1,120 @@
-"""Pallas TPU kernels (the framework's custom-kernel layer).
+"""Pallas TPU kernels (the framework's custom-kernel layer) + registry.
 
 The reference's custom-kernel story is cuDNN/cuBLAS via ATen (SURVEY.md
 §2.6); on TPU, XLA already fuses the CNN stack well, so the in-tree Pallas
-surface targets the op XLA handles least optimally at scale: attention.
-Kernels are opt-in (models default to XLA-compiled jnp) and every kernel has
-a jnp reference implementation it is tested against.
+surface targets the ops XLA handles least optimally at scale: attention
+(``flash_attention``), the ZeRO-1/DP optimizer-update tail
+(``fused_update``) and the grad-compress ring's block-scaled int8
+quantize/dequantize (``fused_quant``/``fused_dequant``). Kernels are
+opt-in (models default to XLA-compiled jnp) and every kernel has a jnp
+reference implementation it is tested against.
+
+``KERNELS`` is the registry: name -> {pallas impl, jnp reference,
+capability predicate, strategy predicate}. Impl/reference are dotted
+``module:attr`` strings resolved lazily (``resolve``) so importing the
+package stays cheap; ``analyze`` uses ``kernel_hints`` to annotate ops
+that have a fused kernel available, and lint's KRN001 uses
+``pallas_backend``/``kernel_available`` as the fail-closed capability
+probe.
 """
+
+from __future__ import annotations
+
+import importlib
+from typing import Optional
 
 from tpu_ddp.ops.flash_attention import flash_attention
 
-__all__ = ["flash_attention"]
+_DP_FAMILY = ("dp", "zero1", "grad_compress", "grad_compress_bf16")
+
+
+def pallas_backend() -> Optional[str]:
+    """How Pallas kernels would execute here: ``"mosaic"`` (compiled, a
+    real TPU), ``"interpret"`` (the CPU interpreter — correct but slow,
+    the CI/parity path), or ``None`` (no supported lowering; the fused
+    switches must fail closed to the XLA path)."""
+    try:
+        from jax.experimental import pallas  # noqa: F401
+    except Exception:  # pragma: no cover - pallas ships with jax
+        return None
+    import jax
+
+    from tpu_ddp.parallel.runtime import is_tpu_device
+
+    if is_tpu_device():
+        return "mosaic"
+    if jax.default_backend() == "cpu":
+        return "interpret"
+    return None
+
+
+#: name -> {impl, reference, capability, strategies, hint}
+KERNELS = {
+    "flash_attention": {
+        "impl": "tpu_ddp.ops.flash_attention:flash_attention",
+        "reference": "tpu_ddp.ops.flash_attention:_reference",
+        "capability": lambda: pallas_backend() is not None,
+        "strategies": (),  # model-level (attention models), not strategy-level
+        "hint": "attention softmax(QK^T)V without materializing the scores",
+    },
+    "fused_update": {
+        "impl": "tpu_ddp.ops.fused_update:FusedUpdate",
+        "reference": "tpu_ddp.ops.fused_update:_reference_leaf",
+        "capability": lambda: pallas_backend() is not None,
+        "strategies": _DP_FAMILY,
+        "hint": ("optimizer update tail (clip + moments + param update "
+                 "+ EMA) in one HBM pass per leaf"),
+    },
+    "fused_quant": {
+        "impl": "tpu_ddp.ops.fused_quant:fused_quant",
+        "reference": "tpu_ddp.ops.fused_quant:_reference_quant",
+        "capability": lambda: pallas_backend() is not None,
+        "strategies": ("grad_compress",),
+        "hint": "ring-hop block-scaled int8 quantize as one fused pass",
+    },
+    "fused_dequant": {
+        "impl": "tpu_ddp.ops.fused_quant:fused_dequant",
+        "reference": "tpu_ddp.ops.fused_quant:_reference_dequant",
+        "capability": lambda: pallas_backend() is not None,
+        "strategies": ("grad_compress",),
+        "hint": ("ring-hop int8 dequantize fused with the carry "
+                 "accumulate (one read of each operand)"),
+    },
+}
+
+
+def resolve(name: str) -> dict:
+    """Registry entry with ``impl``/``reference`` resolved to callables."""
+    entry = dict(KERNELS[name])
+    for key in ("impl", "reference"):
+        mod, _, attr = entry[key].partition(":")
+        entry[key] = getattr(importlib.import_module(mod), attr)
+    return entry
+
+
+def kernel_available(name: str) -> bool:
+    """Capability probe: can this kernel execute here (compiled or
+    interpreted)? False means the fused switch must fall back to XLA."""
+    return bool(KERNELS[name]["capability"]())
+
+
+def kernel_hints(strategy: str) -> list:
+    """"kernel candidate" annotations for ``analyze``: which registry
+    kernels apply to this strategy's step, whether the backend can run
+    them, and what they fuse. Sorted by name for stable output."""
+    hints = []
+    for name in sorted(KERNELS):
+        entry = KERNELS[name]
+        if strategy not in entry["strategies"]:
+            continue
+        hints.append({
+            "kernel": name,
+            "available": bool(entry["capability"]()),
+            "backend": pallas_backend(),
+            "hint": entry["hint"],
+        })
+    return hints
+
+
+__all__ = ["flash_attention", "KERNELS", "resolve", "kernel_available",
+           "kernel_hints", "pallas_backend"]

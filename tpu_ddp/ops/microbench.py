@@ -1,0 +1,347 @@
+"""Measured fused-kernel microbenchmarks: Pallas vs the XLA path.
+
+For every kernel in the ops registry with a strategy-level fused switch
+(``fused_quant``, ``fused_dequant``, ``fused_update``), sweep element
+counts and measure both implementations under jit (min over reps after
+a compile+warmup call — the ``comms/microbench.py`` idiom), running
+each kernel exactly as the Trainer's ``kernels=True`` switch would run
+it here (compiled mosaic on TPU, the interpret/mirror path on CPU). The
+sweeps fit into per-kernel fused/XLA cost lines (``ops/model.py``) and
+are emitted as a schema-versioned artifact that ``registry record``
+classifies as kind ``"ops"`` and ``tune --ops-from`` prices the kernel
+switch with.
+
+Every benched kernel carries an in-bench PARITY verdict: the fused
+output is compared against the XLA reference (jit-vs-jit — XLA:CPU
+contracts FMAs under jit only, so eager-vs-jit comparisons lie),
+bitwise for the quantize/dequantize payloads and the mirror-path
+update. A kernel that fails parity poisons the artifact
+(``parity_ok: false``) and ``ops bench`` exits nonzero naming it — the
+``corrupt`` hook exists so the demo can prove this gate actually trips.
+
+On a CPU host the fused timings are interpret-mode timings: SLOWER than
+XLA, by design reported as negative savings (see ``ops/model.py``) —
+the bench is honest about where kernels do not pay.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tpu_ddp.ops.model import OPS_SCHEMA_VERSION, fit_cost_line
+
+#: the strategy-level kernels this bench sweeps (registry names)
+BENCH_KERNELS = ("fused_quant", "fused_dequant", "fused_update")
+
+#: element counts per sweep point — divisible by the default int8 block
+#: (256) and the update kernel's lane tiling; modest because the CPU
+#: side runs the Pallas interpreter
+DEFAULT_SIZES = (8192, 65536)
+DEFAULT_REPS = 3
+DEFAULT_BLOCK = 256
+
+
+def _time_best(fn, *args, reps: int) -> float:
+    import jax
+
+    jax.block_until_ready(fn(*args))  # compile + warm the dispatch path
+    best = float("inf")
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _bitwise_equal(a, b) -> bool:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    la = jax.tree.leaves(a)
+    lb = jax.tree.leaves(b)
+    if len(la) != len(lb):
+        return False
+    for x, y in zip(la, lb):
+        x = np.asarray(x)
+        y = np.asarray(y)
+        if x.shape != y.shape or x.dtype != y.dtype:
+            return False
+        if x.dtype.kind == "f":
+            xv = np.asarray(jnp.asarray(x).view(jnp.int32)
+                            if x.dtype == np.float32 else x)
+            yv = np.asarray(jnp.asarray(y).view(jnp.int32)
+                            if y.dtype == np.float32 else y)
+            if not np.array_equal(xv, yv, equal_nan=False):
+                return False
+        elif not np.array_equal(x, y):
+            return False
+    return True
+
+
+def _poison(tree):
+    """Deliberately corrupt a fused output (the demo's parity-gate
+    proof): bump the first leaf's first element by one quantum."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(tree)
+    first = leaves[0]
+    flat = first.reshape(-1)
+    bumped = flat.at[0].set(
+        flat[0] + jnp.ones((), dtype=flat.dtype))
+    leaves[0] = bumped.reshape(first.shape)
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def _chunk_input(size: int):
+    import jax.numpy as jnp
+
+    # irrational-ish spread with sign flips and a zero block so the
+    # quantizer's zero-guard path is exercised
+    x = (jnp.arange(size, dtype=jnp.float32) % 257.0 - 128.0) * 0.173
+    return x.at[: min(size, 64)].set(0.0)
+
+
+def _bench_quant(sizes, reps, block, corrupt):
+    import jax
+
+    from tpu_ddp.ops.fused_quant import fused_quant
+    from tpu_ddp.parallel.compression import quantize_chunk
+
+    fused = jax.jit(lambda x: fused_quant(x, block))
+    xla = jax.jit(lambda x: quantize_chunk(x, "int8", block))
+    rows = []
+    parity = True
+    for size in sizes:
+        x = _chunk_input(size)
+        got = fused(x)
+        want = xla(x)
+        if corrupt:
+            got = _poison(got)
+        ok = _bitwise_equal(got, want)
+        parity = parity and ok
+        rows.append({
+            "kernel": "fused_quant", "elements": size,
+            "fused_s": _time_best(fused, x, reps=reps),
+            "xla_s": _time_best(xla, x, reps=reps),
+            "parity_ok": ok,
+        })
+    return rows, parity
+
+
+def _bench_dequant(sizes, reps, block, corrupt):
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_ddp.ops.fused_quant import fused_dequant
+    from tpu_ddp.parallel.compression import (
+        dequantize_chunk,
+        quantize_chunk,
+    )
+
+    fused = jax.jit(
+        lambda p, acc: fused_dequant(p, block, acc.shape[0], add_to=acc))
+    xla = jax.jit(
+        lambda p, acc: acc + dequantize_chunk(p, "int8", block,
+                                              acc.shape[0]))
+    quant = jax.jit(lambda t: quantize_chunk(t, "int8", block))
+    rows = []
+    parity = True
+    for size in sizes:
+        payload = quant(_chunk_input(size))
+        acc = jnp.linspace(-1.0, 1.0, size, dtype=jnp.float32)
+        got = fused(payload, acc)
+        want = xla(payload, acc)
+        if corrupt:
+            got = _poison(got)
+        ok = _bitwise_equal(got, want)
+        parity = parity and ok
+        rows.append({
+            "kernel": "fused_dequant", "elements": size,
+            "fused_s": _time_best(fused, payload, acc, reps=reps),
+            "xla_s": _time_best(xla, payload, acc, reps=reps),
+            "parity_ok": ok,
+        })
+    return rows, parity
+
+
+def _bench_update(sizes, reps, corrupt, optimizer="adamw"):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_ddp.train.optim import make_optimizer
+
+    kwargs = dict(
+        lr=1e-2, weight_decay=1e-4, grad_clip_norm=1.0,
+        optimizer=optimizer, ema_decay=0.999)
+    if optimizer == "sgd":
+        kwargs["momentum"] = 0.9
+    tx_ref = make_optimizer(**kwargs)
+    tx_k = make_optimizer(kernels=True, **kwargs)
+    fused_tx = getattr(tx_k, "fused", None)
+    if fused_tx is None:
+        return [], True  # switch failed closed here; nothing to measure
+
+    def xla_fn(g, s, p):
+        u, ns = tx_ref.update(g, s, p)
+        return optax.apply_updates(p, u), ns
+
+    def fused_fn(g, s, p):
+        np_, _u, ns = fused_tx.apply(g, s, p)
+        return np_, ns
+
+    fused = jax.jit(fused_fn)
+    xla = jax.jit(xla_fn)
+    rows = []
+    parity = True
+    for size in sizes:
+        # 2-D leaf so the default kernels-only decay mask applies
+        p = {"w": (jnp.arange(size, dtype=jnp.float32) % 97.0
+                   * 1e-2).reshape(size // 128, 128)}
+        g = {"w": jnp.cos(jnp.arange(size, dtype=jnp.float32)
+                          ).reshape(size // 128, 128) * 1e-2}
+        s = tx_ref.init(p)
+        got = fused(g, s, p)
+        want = xla(g, s, p)
+        if corrupt:
+            got = (_poison(got[0]), got[1])
+        ok = _bitwise_equal(got, want)
+        parity = parity and ok
+        rows.append({
+            "kernel": "fused_update", "variant": optimizer,
+            "elements": size,
+            "fused_s": _time_best(fused, g, s, p, reps=reps),
+            "xla_s": _time_best(xla, g, s, p, reps=reps),
+            "parity_ok": ok,
+        })
+    return rows, parity
+
+
+def run_sweeps(
+    *,
+    kernels: Sequence[str] = BENCH_KERNELS,
+    sizes: Sequence[int] = DEFAULT_SIZES,
+    reps: int = DEFAULT_REPS,
+    block: int = DEFAULT_BLOCK,
+    corrupt: Optional[str] = None,
+    progress=None,
+) -> Tuple[List[dict], List[dict]]:
+    """Measure every (kernel, elements) combination; returns ``(sweeps,
+    skipped)``. A kernel that fails to build or run is recorded in
+    ``skipped`` with the error, never fatal. ``corrupt`` names a kernel
+    whose fused output is deliberately perturbed before the parity
+    comparison — the demo's proof that the gate trips."""
+    sweeps: List[dict] = []
+    skipped: List[dict] = []
+    benchers = {
+        "fused_quant": lambda: _bench_quant(
+            sizes, reps, block, corrupt == "fused_quant"),
+        "fused_dequant": lambda: _bench_dequant(
+            sizes, reps, block, corrupt == "fused_dequant"),
+        "fused_update": lambda: _bench_update(
+            sizes, reps, corrupt == "fused_update"),
+    }
+    for name in kernels:
+        bench = benchers.get(name)
+        if bench is None:
+            skipped.append({"kernel": name,
+                            "error": f"unknown bench kernel {name!r}"})
+            continue
+        try:
+            rows, _parity = bench()
+        except Exception as e:
+            skipped.append({"kernel": name,
+                            "error": f"{type(e).__name__}: {e}"})
+            continue
+        if not rows:
+            skipped.append({"kernel": name,
+                            "error": "kernel unavailable on this backend"})
+            continue
+        sweeps.extend(rows)
+        if progress:
+            for row in rows:
+                progress(row)
+    return sweeps, skipped
+
+
+def fit_kernels(sweeps: Sequence[dict]) -> Dict[str, dict]:
+    """Per-kernel fused/xla cost-line fits plus the parity verdict;
+    kernels with fewer than two distinct sizes are dropped (no line
+    through one point)."""
+    grouped: Dict[str, List[dict]] = {}
+    for row in sweeps:
+        grouped.setdefault(row["kernel"], []).append(row)
+    out: Dict[str, dict] = {}
+    for name, rows in grouped.items():
+        xs = [r["elements"] for r in rows]
+        if len(set(xs)) < 2:
+            continue
+        fused = fit_cost_line(xs, [r["fused_s"] for r in rows])
+        xla = fit_cost_line(xs, [r["xla_s"] for r in rows])
+        speedups = [r["xla_s"] / r["fused_s"]
+                    for r in rows if r["fused_s"] > 0]
+        out[name] = {
+            "fused": fused.to_json(),
+            "xla": xla.to_json(),
+            "parity_ok": all(r["parity_ok"] for r in rows),
+            # headline per kernel: best measured XLA/fused ratio (>1
+            # means the fused kernel wins here)
+            "speedup": max(speedups) if speedups else 0.0,
+        }
+    return out
+
+
+def bench_artifact(sweeps: Sequence[dict], skipped: Sequence[dict],
+                   *, reps: int = DEFAULT_REPS) -> dict:
+    """The schema-versioned ``ops bench --json`` artifact. The headline
+    key is the median per-kernel speedup (quality, higher is better);
+    per-kernel ``rows`` trend through the registry's measured channel;
+    ``parity_ok`` is the gate ``ops bench`` exits nonzero on."""
+    import statistics
+
+    import jax
+
+    from tpu_ddp.ops import pallas_backend
+    from tpu_ddp.ops.model import _chip_key
+    from tpu_ddp.telemetry.provenance import artifact_provenance
+
+    devices = jax.devices()
+    device_kind = str(devices[0].device_kind)
+    chip = _chip_key(device_kind) or device_kind
+    fitted = fit_kernels(sweeps)
+    parity_ok = (all(k["parity_ok"] for k in fitted.values())
+                 and all(r["parity_ok"] for r in sweeps))
+    failing = sorted({r["kernel"] for r in sweeps if not r["parity_ok"]})
+    speedups = [k["speedup"] for k in fitted.values() if k["speedup"] > 0]
+    ops = {
+        "chip": chip,
+        "device_kind": device_kind,
+        "backend": pallas_backend(),
+        "n_devices": len(devices),
+        "reps": reps,
+        # headline gate: the median per-kernel fused speedup (quality,
+        # higher is better; < 1 on interpret-mode CPU — honest)
+        "speedup": statistics.median(speedups) if speedups else 0.0,
+        "parity_ok": parity_ok,
+        "parity_failures": failing,
+        "kernels": {k: v for k, v in sorted(fitted.items())},
+        # registry trend channel: one measured row per kernel
+        "rows": {f"ops/{name}": {"value": fitted[name]["speedup"]}
+                 for name in sorted(fitted)},
+        "sweeps": list(sweeps),
+        "skipped": list(skipped),
+    }
+    return {
+        "type": "ops",
+        "ops_schema_version": OPS_SCHEMA_VERSION,
+        "provenance": artifact_provenance(
+            descriptor={"artifact": "ops_bench", "chip": chip,
+                        "backend": ops["backend"],
+                        "n_devices": len(devices)},
+            device_kind=device_kind, jax_version=jax.__version__,
+        ),
+        "ops": ops,
+    }

@@ -102,8 +102,37 @@ def axis_size(axis: str):
     return lax.axis_size(axis)
 
 
+def _quant(p, mode: str, block: int, kernels: bool):
+    """One wire payload: the fused Pallas quantizer when the kernel
+    switch is on (int8 only — f32/bf16 payloads are casts, nothing to
+    fuse), else the jnp reference. Bit-identical by contract
+    (``ops/fused_quant.py``)."""
+    from tpu_ddp.parallel.compression import quantize_chunk
+
+    if kernels and mode == "int8":
+        from tpu_ddp.ops.fused_quant import fused_quant
+
+        return fused_quant(p, block)
+    return quantize_chunk(p, mode, block)
+
+
+def _dequant(payload, mode: str, block: int, size: int, kernels: bool,
+             add_to=None):
+    """Payload -> f32 chunk, optionally fused with the ring's carry
+    accumulate (one pass instead of dequantize-then-add)."""
+    from tpu_ddp.parallel.compression import dequantize_chunk
+
+    if kernels and mode == "int8":
+        from tpu_ddp.ops.fused_quant import fused_dequant
+
+        return fused_dequant(payload, block, size, add_to=add_to)
+    d = dequantize_chunk(payload, mode, block, size)
+    return d if add_to is None else add_to + d
+
+
 def ring_reduce_scatter(x, axis: str, *, mode: str = "f32",
                         block: int = 256, with_error: bool = False,
+                        kernels: bool = False,
                         _hook_kind: str = "ring-reduce-scatter",
                         _hook_total_hops: int = 0):
     """Ring reduce-scatter of a 1-D array built from ``ppermute``, with
@@ -131,12 +160,11 @@ def ring_reduce_scatter(x, axis: str, *, mode: str = "f32",
     quantization error THIS device introduced, a full-length f32 array
     with each hop's error at its chunk's offsets — the error-feedback
     residual contribution. ``err`` is None when not requested, all-zero
-    in f32 mode."""
-    from tpu_ddp.parallel.compression import (
-        dequantize_chunk,
-        quantize_chunk,
-    )
+    in f32 mode.
 
+    ``kernels`` routes the int8 payload ops through the fused Pallas
+    quantize / dequantize-accumulate kernels (bit-identical wire bytes
+    and error-feedback residuals — the roundtrip parity contract)."""
     n = lax.axis_size(axis)
     if x.shape[0] % n:
         raise ValueError(
@@ -152,29 +180,34 @@ def ring_reduce_scatter(x, axis: str, *, mode: str = "f32",
     p = jnp.take(chunks, (idx - 1) % n, axis=0, mode="wrap")
     err = jnp.zeros_like(x) if with_error else None
     for step in range(n - 1):
-        payload = quantize_chunk(p, mode, block)
+        payload = _quant(p, mode, block, kernels)
         if with_error and mode != "f32":
-            e = p - dequantize_chunk(payload, mode, block, s)
+            e = p - _dequant(payload, mode, block, s, kernels)
             # the chunk being sent this hop is (idx - 1 - step) mod n
             err = lax.dynamic_update_slice(
                 err, e, (((idx - 1 - step) % n) * s,))
         payload = jax.tree.map(
             lambda t: lax.ppermute(t, axis, perm), payload)
-        p = dequantize_chunk(payload, mode, block, s)
+        nxt = jnp.take(chunks, (idx - 2 - step) % n, axis=0, mode="wrap")
         if _RING_HOP_HOOK is not None:
             from tpu_ddp.parallel.compression import chunk_wire_bytes
 
+            # the hook's probe must observe the BARE dequantized chunk
+            # (pre-accumulate), so the fused accumulate stays off here
+            p = _dequant(payload, mode, block, s, kernels)
             _emit_hop(
                 p[0], kind=_hook_kind, mode=mode, axis=axis,
                 hop=step + 1,
                 n_hops=_hook_total_hops or (n - 1),
                 wire_bytes=chunk_wire_bytes(s, mode, block))
-        p = p + jnp.take(chunks, (idx - 2 - step) % n, axis=0, mode="wrap")
+            p = p + nxt
+        else:
+            p = _dequant(payload, mode, block, s, kernels, add_to=nxt)
     return p, err
 
 
 def ring_all_reduce(x, axis: str, *, mode: str = "f32", block: int = 256,
-                    with_error: bool = False):
+                    with_error: bool = False, kernels: bool = False):
     """Ring all-reduce (SUM) with wire compression in BOTH phases:
     the compressed ring reduce-scatter above, then each device quantizes
     its reduced chunk ONCE and the payloads are all-gathered — every
@@ -184,29 +217,25 @@ def ring_all_reduce(x, axis: str, *, mode: str = "f32", block: int = 256,
     ``lax.psum`` up to the reduce-scatter's summation-order caveat.
 
     Returns ``(sum, err)`` with ``err`` as in ``ring_reduce_scatter``
-    plus the owner-side all-gather-phase quantization error."""
-    from tpu_ddp.parallel.compression import (
-        dequantize_chunk,
-        quantize_chunk,
-    )
-
+    plus the owner-side all-gather-phase quantization error.
+    ``kernels`` as in ``ring_reduce_scatter``."""
     n = lax.axis_size(axis)
     if n == 1:
         return x, (jnp.zeros_like(x) if with_error else None)
     s = x.shape[0] // n
     chunk, err = ring_reduce_scatter(
         x, axis, mode=mode, block=block, with_error=with_error,
-        _hook_kind="ring-all-reduce", _hook_total_hops=n)
-    payload = quantize_chunk(chunk, mode, block)
+        kernels=kernels, _hook_kind="ring-all-reduce", _hook_total_hops=n)
+    payload = _quant(chunk, mode, block, kernels)
     if with_error and mode != "f32":
-        e = chunk - dequantize_chunk(payload, mode, block, s)
+        e = chunk - _dequant(payload, mode, block, s, kernels)
         idx = lax.axis_index(axis)
         err = lax.dynamic_update_slice(err, e, (idx * s,))
     gathered = jax.tree.map(
         lambda t: lax.all_gather(t, axis, axis=0, tiled=False), payload)
     rows = jnp.stack([
-        dequantize_chunk(
-            jax.tree.map(lambda t: t[i], gathered), mode, block, s)
+        _dequant(jax.tree.map(lambda t: t[i], gathered),
+                 mode, block, s, kernels)
         for i in range(n)
     ])
     out = rows.reshape(-1)

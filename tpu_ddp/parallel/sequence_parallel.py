@@ -29,6 +29,7 @@ from tpu_ddp.compat import GRAD_SYNC_IN_AD
 from tpu_ddp.health.stats import HealthConfig, guard_step, health_stats
 from tpu_ddp.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
 from tpu_ddp.train.losses import cross_entropy_loss
+from tpu_ddp.train.optim import apply_optimizer
 from tpu_ddp.train.state import TrainState
 
 
@@ -118,9 +119,8 @@ def make_sp_train_step(
             if compress is not None:
                 grads, err_state = compress.all_reduce_mean(
                     grads, residual, with_error=want_err)
-            updates, new_opt_state = tx.update(
-                grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
+            new_params, updates, new_opt_state = apply_optimizer(
+                tx, grads, state.opt_state, state.params)
         new_residual = err_state if ef else state.grad_residual
         metrics = {"loss": loss}
         if health is not None:

@@ -279,9 +279,17 @@ class Zero1Partition:
             grads, residual, with_error=with_error)
         psh = self.local_shard(self.flatten(params))
         with jax.named_scope("tpu_ddp.zero1_shard_update"):
-            updates, new_opt_state = self.tx.update(gsh, opt_state, psh)
-            updates = self.mask_pad(updates)
-            new_psh = optax.apply_updates(psh, updates)
+            fused = getattr(self.tx, "fused", None)
+            if fused is not None:
+                # the single-pass Pallas tail (ops/fused_update.py): one
+                # HBM pass per leaf instead of the materialized optax
+                # chain; returns updates already pad-masked
+                new_psh, updates, new_opt_state = fused.apply_sharded(
+                    gsh, opt_state, psh, partition=self)
+            else:
+                updates, new_opt_state = self.tx.update(gsh, opt_state, psh)
+                updates = self.mask_pad(updates)
+                new_psh = optax.apply_updates(psh, updates)
         with jax.named_scope("tpu_ddp.zero1_allgather_params"):
             new_params = self.gather_params(new_psh)
         return new_params, new_opt_state, gsh, updates, err_state

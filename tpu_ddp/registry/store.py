@@ -198,6 +198,11 @@ def _artifact_kind(art: dict) -> str:
         # (docs/data.md) — also outranks the "rows" fallback (its record
         # carries a per-stage rows trend channel)
         return "data"
+    if "ops_schema_version" in art or isinstance(art.get("ops"), dict):
+        # `tpu-ddp ops bench --json`: the measured fused-kernel cost
+        # model (docs/kernels.md) — also outranks the "rows" fallback
+        # (its record carries a per-kernel rows trend channel)
+        return "ops"
     if "images_per_sec_per_chip" in art or "vs_baseline" in art \
             or "rows" in art:
         return "bench"

@@ -142,7 +142,13 @@ QUALITY_DIGEST_EXCLUDED = (
 #: the same band series, so `tpu-ddp curves --against` can be the final
 #: arbiter that a recovered run still learned (docs/resilience.md,
 #: docs/curves.md).
-QUALITY_DIGEST_LAYOUT_KEYS = ("n_devices", "mesh", "per_shard_batch")
+#: ``kernels`` rides along: the fused Pallas tier is bit-identical to
+#: the XLA path BY CONTRACT (ops/fused_update.py, ops/fused_quant.py;
+#: gated by `ops bench` and tests/test_fused_kernels.py), so flipping
+#: the switch must not split a seed-band series — the learning recipe
+#: is the same recipe.
+QUALITY_DIGEST_LAYOUT_KEYS = ("n_devices", "mesh", "per_shard_batch",
+                              "kernels")
 
 
 def quality_digest(config_snapshot: dict,

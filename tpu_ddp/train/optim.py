@@ -95,6 +95,7 @@ def make_optimizer(
     ema_decay: float = 0.0,
     decay_mask: Optional[Any] = None,
     zero1_axis: Optional[str] = None,
+    kernels: bool = False,
 ) -> optax.GradientTransformation:
     """freeze_predicate(path_tuple, leaf) -> True to FREEZE that param.
     ``grad_clip_norm`` > 0 clips the GLOBAL gradient norm before the update
@@ -121,7 +122,16 @@ def make_optimizer(
     ``decay_mask`` (a per-leaf bool pytree — ndim is meaningless on the
     flattened leaves). lamb is rejected: its per-LAYER trust ratios need
     whole-leaf norms that a 1/N slice cannot provide. Everything else in
-    the chain is elementwise and shards exactly."""
+    the chain is elementwise and shards exactly.
+
+    ``kernels`` attaches the single-pass Pallas update tail
+    (``ops/fused_update.py``) as ``tx.fused`` — ``apply_optimizer`` and
+    ``Zero1Partition.sharded_update`` opt into it; ``init``/``update``
+    stay the reference chain's, so checkpoint layout and every direct
+    ``tx.update`` caller are untouched. Fails closed (plain chain, no
+    ``.fused``) for optimizers without a kernel (lamb) and on backends
+    whose capability probe lacks Pallas support — lint's KRN001 names
+    the fallback."""
     if grad_clip_norm < 0:
         raise ValueError(f"grad_clip_norm must be >= 0, got {grad_clip_norm}")
     if zero1_axis is not None and optimizer == "lamb":
@@ -181,6 +191,7 @@ def make_optimizer(
         else:
             tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
 
+    labeler = None
     if freeze_predicate is not None:
         import jax
 
@@ -197,7 +208,33 @@ def make_optimizer(
         # outermost-last so the shadow sees the FINAL updates (after lr,
         # clip, decay, and any freeze masking)
         tx = optax.chain(tx, params_ema(ema_decay))
+    if kernels and optimizer in ("sgd", "adamw"):
+        from tpu_ddp.ops import kernel_available
+
+        if kernel_available("fused_update"):
+            from tpu_ddp.ops.fused_update import UpdateRecipe, fuse_optimizer
+
+            tx = fuse_optimizer(tx, UpdateRecipe(
+                optimizer=optimizer, lr=lr_sched, momentum=momentum,
+                weight_decay=weight_decay, decay_mask=mask,
+                grad_clip_norm=grad_clip_norm, zero1_axis=zero1_axis,
+                labeler=labeler, ema_decay=ema_decay,
+            ))
     return tx
+
+
+def apply_optimizer(tx, grads, opt_state, params):
+    """The replicated update tail: ``(new_params, updates,
+    new_opt_state)``. Dispatches to the fused single-pass kernel when
+    ``make_optimizer(kernels=True)`` attached one, else the reference
+    ``tx.update`` + ``optax.apply_updates`` — the two are bit-identical
+    (the fused path's contract), so step builders call this
+    unconditionally."""
+    fused = getattr(tx, "fused", None)
+    if fused is not None:
+        return fused.apply(grads, opt_state, params)
+    updates, new_opt_state = tx.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), updates, new_opt_state
 
 
 def freeze_all_but(prefixes: tuple) -> Callable:

@@ -4,6 +4,12 @@ A superset of what the reference persists: it saves only
 ``model.state_dict()`` (``main.py:45``) and silently drops optimizer state —
 lossless there only because plain SGD is stateless. Here
 ``{step, params, batch_stats, opt_state}`` travel together (SURVEY.md §5.4).
+
+The fused Pallas kernel tier (``--kernels``, docs/kernels.md) reads and
+writes this state through the SAME optax layout ``make_optimizer``
+builds — the fused update navigates ``opt_state`` in place of running
+the chain, it never reshapes it — so checkpoints, opt-slot derivation,
+and restores are byte-compatible across the switch in both directions.
 """
 
 from __future__ import annotations

@@ -48,6 +48,7 @@ from tpu_ddp.train.losses import (
     cross_entropy_loss,
     masked_accuracy,
 )
+from tpu_ddp.train.optim import apply_optimizer
 from tpu_ddp.train.state import TrainState
 
 # GRAD_SYNC_IN_AD (tpu_ddp.compat): where the DDP gradient sync lives.
@@ -266,10 +267,8 @@ def _make_shard_step(
                 grads = jax.tree.map(
                     lambda g: lax.pmean(g, data_axis), grads)
             with jax.named_scope("tpu_ddp.optimizer_update"):
-                updates, new_opt_state = tx.update(
-                    grads, state.opt_state, state.params
-                )
-                new_params = optax.apply_updates(state.params, updates)
+                new_params, updates, new_opt_state = apply_optimizer(
+                    tx, grads, state.opt_state, state.params)
         new_residual = err_state if ef else state.grad_residual
         if health is not None:
             # grads/updates are the synchronized values in EVERY sync mode
@@ -580,9 +579,8 @@ def make_grad_accum_train_step(
             elif not GRAD_SYNC_IN_AD:  # _make_shard_step: explicit sync
                 grads = jax.tree.map(
                     lambda g: lax.pmean(g, data_axis), grads)
-            updates, new_opt_state = tx.update(
-                grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
+            new_params, updates, new_opt_state = apply_optimizer(
+                tx, grads, state.opt_state, state.params)
         new_residual = err_state if ef else state.grad_residual
         if health is not None:
             # same guarantees as _make_shard_step: grads/updates are the
@@ -739,8 +737,8 @@ def make_auto_train_step(
         (loss, new_stats), grads = jax.value_and_grad(compute_loss, has_aux=True)(
             state.params, state.batch_stats, batch
         )
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+        new_params, updates, new_opt_state = apply_optimizer(
+            tx, grads, state.opt_state, state.params)
         return (
             state.replace(
                 step=state.step + 1,

@@ -112,6 +112,18 @@ class TrainConfig:
                                           # TrainState.grad_residual,
                                           # per-device like zero1's opt
                                           # shards; checkpointed)
+    kernels: bool = False                 # route the DP-family update
+                                          # tail (fused clip+moments+
+                                          # param+EMA pass) and the int8
+                                          # ring's quantize/dequantize
+                                          # through the Pallas kernel
+                                          # tier (ops/, docs/kernels.md).
+                                          # Bit-identical math by
+                                          # contract; fails closed to the
+                                          # XLA path per kernel on
+                                          # backends without Pallas
+                                          # support (lint KRN001 names
+                                          # the fallback)
     mesh: Optional[dict] = None           # axis sizes, e.g. {"data": 2,
                                           # "model": 4}; None = strategy default
     n_microbatches: int = 4               # pipeline microbatches (pp only)
@@ -875,6 +887,7 @@ class Trainer:
             ema_decay=config.ema_decay,
             decay_mask=decay_mask,
             zero1_axis=zero1_axis,
+            kernels=config.kernels,
         )
         from tpu_ddp.train.losses import (
             binary_cross_entropy_with_logits,
@@ -1062,6 +1075,7 @@ class Trainer:
                 mode=config.grad_compress,
                 block=config.grad_compress_block,
                 error_feedback=config.grad_compress_error_feedback,
+                kernels=config.kernels,
             ),
             params_template, self.data_size, axis=DATA_AXIS,
         )
@@ -1712,6 +1726,12 @@ class Trainer:
             self.train_step, state, batch, self.mesh, strategy=label,
             compute_dtype=c.compute_dtype, model_name=c.model,
         )
+        if c.kernels:
+            from tpu_ddp.analysis.lint import lint_kernels
+
+            # KRN001 fail-closed audit: --kernels on a backend with no
+            # Pallas lowering must refuse here, not silently fall back
+            findings = findings + lint_kernels(True, program=label)
         if self.multi_step is not None:
             stacked = {
                 k: _jax.ShapeDtypeStruct(

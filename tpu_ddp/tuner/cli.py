@@ -109,6 +109,8 @@ def winner_config_fields(priced, *, model_name: str, n_chans1: int,
         fields["grad_compress_block"] = 256
     if c.parallelism == "pp":
         fields["n_microbatches"] = 2
+    if c.kernels:
+        fields["kernels"] = True
     return fields
 
 
@@ -131,6 +133,8 @@ def winner_cli_line(fields: dict) -> str:
         parts.append("--zero1")
     if fields.get("grad_compress", "none") != "none":
         parts.append(f"--grad-compress {fields['grad_compress']}")
+    if fields.get("kernels"):
+        parts.append("--kernels")
     if fields.get("n_microbatches"):
         parts.append(f"--microbatches {fields['n_microbatches']}")
     parts.append(f"--compute-dtype {fields['compute_dtype']}")
@@ -160,7 +164,8 @@ def render_result(result, *, top: int = 0) -> str:
         f"x{result.hbm_calibration_ratio:g} "
         f"[{result.hbm_calibration_source}], comms "
         f"[{result.comms_calibration_source}], data "
-        f"[{result.data_calibration_source}])",
+        f"[{result.data_calibration_source}], ops "
+        f"[{result.ops_calibration_source}])",
         "",
     ]
     rows = result.ranked[:top] if top else result.ranked
@@ -219,6 +224,7 @@ def tune_artifact(result) -> dict:
                             "source": result.hbm_calibration_source},
         "comms_calibration": {"source": result.comms_calibration_source},
         "data_calibration": {"source": result.data_calibration_source},
+        "ops_calibration": {"source": result.ops_calibration_source},
         "grid": result.grid_descriptor(),
         "n_candidates": len(result.ranked) + len(result.excluded),
         "n_ranked": len(result.ranked),
@@ -337,6 +343,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "docs/data.md). Candidates the loader cannot "
                          "feed are excluded input_bound, named like "
                          "over_hbm exclusions")
+    ap.add_argument("--ops-from", action="append", default=[],
+                    metavar="PATH", dest="ops_from",
+                    help="`tpu-ddp ops bench --json` artifact whose "
+                         "fitted per-kernel cost lines price the fused "
+                         "Pallas kernel switch (repeatable; wrong-chip "
+                         "evidence is ignored; docs/kernels.md). With "
+                         "measured ops evidence the grid doubles along "
+                         "a kernels on/off axis for the dp family and "
+                         "the SIGNED measured saving ranks the switch "
+                         "honestly — negative savings rank kernel-off "
+                         "first")
     ap.add_argument("--registry", default=None, metavar="DIR",
                     help="perf-registry workspace: archived validated "
                          "tune entries join the time calibration, "
@@ -401,6 +418,13 @@ def _run(args) -> int:
 
     data_model = data_model_from_sources(
         args.data_from, registry_dir=args.registry)
+    # measured fused-kernel model (docs/kernels.md): `ops bench`
+    # artifacts + ops-kind registry entries; with evidence, dp-family
+    # candidates grow a kernels-on twin priced by the SIGNED saving
+    from tpu_ddp.ops.model import ops_model_for_chip
+
+    ops_model = ops_model_for_chip(
+        chip, sources=args.ops_from, registry_dir=args.registry)
     if spec is None or (spec.peak_bf16_flops is None
                         and not comms_model):
         raise ValueError(
@@ -434,6 +458,16 @@ def _run(args) -> int:
     if not candidates:
         raise ValueError("the grid enumerated no candidates (check "
                          "--strategies against the model family)")
+    if ops_model:
+        # double the dp family along the kernel switch: the twin shares
+        # its base's compiled program + lint audit (program_key ignores
+        # `kernels` — the fused tier is bit-identical by contract) and
+        # differs only in the measured savings term
+        import dataclasses as _dc
+
+        candidates = candidates + [
+            _dc.replace(c, kernels=True)
+            for c in candidates if c.parallelism == "dp"]
     calibration = calibration_for_chip(
         chip, sources=args.calibrate_from, registry_dir=args.registry)
     # HBM-cap calibration (docs/memory.md): `tpu-ddp mem --json`
@@ -461,6 +495,9 @@ def _run(args) -> int:
         data_model=data_model or None,
         data_calibration_source=data_model.source
         if data_model else "none",
+        ops_model=ops_model or None,
+        ops_calibration_source=ops_model.source
+        if ops_model else "none",
         dispatch_overhead_s=(
             args.dispatch_overhead_us * 1e-6
             if args.dispatch_overhead_us is not None

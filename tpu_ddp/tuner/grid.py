@@ -55,6 +55,12 @@ class Candidate:
     grad_compress: Optional[str]
     per_shard_batch: int
     steps_per_call: int
+    #: the fused Pallas kernel switch (``TrainConfig.kernels``). NOT in
+    #: ``program_key()``: the fused tier is bit-identical to the XLA
+    #: path by contract, so kernel-on/off variants deliberately share
+    #: one compiled program + lint audit and differ only in pricing
+    #: (the measured ``ops bench`` savings, ``--ops-from``)
+    kernels: bool = False
 
     def mesh_sizes(self, n_devices: int) -> Dict[str, int]:
         """Nontrivial ``{axis: size}`` for ``n_devices`` chips."""
@@ -106,6 +112,8 @@ class Candidate:
             head += "+zero1"
         if self.grad_compress:
             head += f"+gc:{self.grad_compress}"
+        if self.kernels:
+            head += "+krn"
         mesh = ",".join(f"{a}={s}"
                         for a, s in self.mesh_sizes(n_devices).items())
         return (f"{head}/{mesh}/b{self.per_shard_batch}"

@@ -81,6 +81,7 @@ class PricedCandidate:
         default_factory=dict)
     measured: Optional[dict] = None           # --validate-top join
     input_floor_s: Optional[float] = None     # --data-from measured floor
+    kernel_savings_s: Optional[float] = None  # --ops-from SIGNED saving
 
     @property
     def predicted_step_us(self) -> Optional[int]:
@@ -98,6 +99,7 @@ class PricedCandidate:
             "grad_compress": c.grad_compress,
             "per_shard_batch": c.per_shard_batch,
             "steps_per_call": c.steps_per_call,
+            "kernels": c.kernels,
             "status": self.status,
             "predicted_step_us": self.predicted_step_us,
             "predicted_images_per_sec_per_chip":
@@ -114,6 +116,9 @@ class PricedCandidate:
             rec["measured"] = self.measured
         if self.input_floor_s is not None:
             rec["input_floor_us"] = int(round(self.input_floor_s * 1e6))
+        if self.kernel_savings_s is not None:
+            rec["kernel_savings_us"] = round(
+                self.kernel_savings_s * 1e6, 1)
         return rec
 
 
@@ -146,6 +151,10 @@ class TuneResult:
     # `--data-from` evidence whose per-image host cost priced every
     # candidate's input-bound floor
     data_calibration_source: str = "none"
+    # measured fused-kernel calibration (docs/kernels.md): names the
+    # `--ops-from` evidence whose per-kernel cost model priced the
+    # kernel-on candidates' SIGNED savings term
+    ops_calibration_source: str = "none"
 
     @property
     def winner(self) -> Optional[PricedCandidate]:
@@ -171,6 +180,7 @@ class TuneResult:
             "hbm_calibration_ratio": self.hbm_calibration_ratio,
             "comms_calibration_source": self.comms_calibration_source,
             "data_calibration_source": self.data_calibration_source,
+            "ops_calibration_source": self.ops_calibration_source,
         }
 
 
@@ -258,6 +268,8 @@ def price_anatomy(
     lint_errors: Sequence[str] = (),
     comms_model=None,
     data_model=None,
+    ops_model=None,
+    param_elements: Optional[int] = None,
 ) -> PricedCandidate:
     """The pure pricing tail over an already-extracted anatomy: lint
     verdict -> HBM cap -> roofline -> calibration -> dispatch
@@ -282,7 +294,16 @@ def price_anatomy(
     symmetric pod divides the load by its host count), and a candidate
     whose floor exceeds its compute-side step cannot be fed — it is
     excluded ``input_bound``, named like an ``over_hbm`` exclusion
-    (docs/data.md)."""
+    (docs/data.md).
+
+    ``ops_model`` (an ``ops/model.py`` OpsModel with evidence,
+    ``--ops-from``) prices the fused-kernel switch on kernel-on
+    candidates: the benched per-element cost lines give a SIGNED
+    per-step saving for ``fused_update`` over the optimizer's shard and
+    for ``fused_quant``/``fused_dequant`` over the int8 ring's hops.
+    The sign is honest — where the bench measured the fused path slower
+    (e.g. interpret mode on CPU), the saving is negative and kernel-off
+    outranks kernel-on (docs/kernels.md)."""
     from tpu_ddp.analysis.roofline import chip_spec, roofline
 
     name = cand.name(n_devices)
@@ -334,6 +355,34 @@ def price_anatomy(
     effective = (rl.predicted_step_s * calibration_ratio
                  + dispatch_overhead_s / max(cand.steps_per_call, 1))
     data = cand.mesh_sizes(n_devices).get("data", 1)
+    kernel_savings = None
+    if cand.kernels and ops_model is not None and param_elements:
+        parts = []
+        # fused_update sweeps the optimizer's own shard: the zero1
+        # scatter leaves each chip 1/data of the flat param space
+        shard = max(param_elements // (data if cand.zero1 else 1), 1)
+        s = ops_model.savings_s("fused_update", shard)
+        if s is not None:
+            parts.append(s)
+        if cand.grad_compress == "int8" and data > 1:
+            # the compressed ring moves per-chip chunks of 1/data of
+            # the grads; reduce-scatter quantizes/dequant-accumulates
+            # data-1 hops, and the plain all-reduce's gather phase
+            # adds one more encode and data more decodes
+            chunk = max(param_elements // data, 1)
+            hops = data - 1
+            q_count = hops + (0 if cand.zero1 else 1)
+            d_count = hops + (0 if cand.zero1 else data)
+            for kname, count in (("fused_quant", q_count),
+                                 ("fused_dequant", d_count)):
+                s = ops_model.savings_s(kname, chunk, count=count)
+                if s is not None:
+                    parts.append(s)
+        if parts:
+            kernel_savings = sum(parts)
+            # SIGNED: a bench that measured the fused path slower
+            # (interpret mode) makes effective LONGER — kernel-off wins
+            effective = max(effective - kernel_savings, 1e-9)
     input_floor = None
     if data_model:
         images_per_step = cand.per_shard_batch * data
@@ -356,6 +405,7 @@ def price_anatomy(
                 hbm_fraction=(round(hbm_fraction, 4)
                               if hbm_fraction is not None else None),
                 lint_rule_counts=counts, input_floor_s=input_floor,
+                kernel_savings_s=kernel_savings,
             )
     throughput = cand.per_shard_batch * data / n_devices / effective
     return PricedCandidate(
@@ -367,6 +417,7 @@ def price_anatomy(
         hbm_fraction=(round(hbm_fraction, 4)
                       if hbm_fraction is not None else None),
         lint_rule_counts=counts, input_floor_s=input_floor,
+        kernel_savings_s=kernel_savings,
     )
 
 
@@ -388,6 +439,8 @@ def tune(
     comms_calibration_source: str = "none",
     data_model=None,
     data_calibration_source: str = "none",
+    ops_model=None,
+    ops_calibration_source: str = "none",
     dispatch_overhead_s: float = DEFAULT_DISPATCH_OVERHEAD_S,
     overlap: str = "overlapped",
     lint_config=None,
@@ -429,12 +482,20 @@ def tune(
                     cache_key=prog.cache_key, config=lint_config,
                     program=cand.name(n), model_name=model_name,
                 )
-                audits[pkey] = (findings, audit, None)
+                import math
+
+                import jax
+
+                n_params = sum(
+                    int(math.prod(leaf.shape))
+                    for leaf in jax.tree.leaves(prog.state.params))
+                audits[pkey] = (findings, audit, n_params, None)
             except Exception as e:  # an uncompilable candidate is a
                 # grid bug (the enumeration contract) — surface it as
                 # an excluded row, never a crashed sweep
-                audits[pkey] = (None, None, f"{type(e).__name__}: {e}")
-        findings, audit, err = audits[pkey]
+                audits[pkey] = (None, None, None,
+                                f"{type(e).__name__}: {e}")
+        findings, audit, n_params, err = audits[pkey]
         if err is not None:
             excluded.append(PricedCandidate(
                 candidate=cand, name=cand.name(n),
@@ -449,6 +510,7 @@ def tune(
             dispatch_overhead_s=dispatch_overhead_s, overlap=overlap,
             lint_rule_counts=rule_counts(findings), lint_errors=errors,
             comms_model=comms_model, data_model=data_model,
+            ops_model=ops_model, param_elements=n_params,
         )
         (ranked if priced.status == STATUS_OK else excluded).append(priced)
     ranked.sort(key=lambda p: (-p.predicted_images_per_sec_per_chip,
@@ -463,6 +525,7 @@ def tune(
         hbm_calibration_source=hbm_calibration_source,
         comms_calibration_source=comms_calibration_source,
         data_calibration_source=data_calibration_source,
+        ops_calibration_source=ops_calibration_source,
         ranked=ranked, excluded=excluded,
         compiled_programs=len(audits),
         image_size=image_size, overlap=overlap,

@@ -35,6 +35,7 @@ WINNER_CONFIG_FIELDS = (
     "model", "n_chans1", "n_blocks", "num_classes", "compute_dtype",
     "parallelism", "mesh", "zero1", "grad_compress", "grad_compress_block",
     "per_shard_batch", "steps_per_call", "n_devices", "n_microbatches",
+    "kernels",
 )
 
 
