@@ -334,6 +334,7 @@ def test_int8_step_trajectory_close(devices):
     )
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_scan_step_carries_residual(devices):
     """Scan-fused K-step: the residual rides the carry. In f32 mode the
     fused trajectory matches K single steps to reduction-order tolerance
@@ -383,6 +384,7 @@ def test_scan_step_carries_residual(devices):
                for x in jax.tree.leaves(s8.grad_residual))
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_zero1_composition_uneven_padding(devices):
     """--zero1 + --grad-compress: the compressed ring drops into the
     partition's reduce-scatter (uneven-padding leaves — see _model) —

@@ -139,6 +139,7 @@ def test_ema_updates_inside_scan_fused_step():
         np.testing.assert_allclose(a, b, atol=1e-5)
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_trainer_ema_eval_and_resume(tmp_path):
     """End-to-end: train with --ema-decay, eval reads the EMA weights, and
     a checkpoint round-trip preserves the shadow exactly."""

@@ -147,6 +147,7 @@ def test_moe_aux_loss_sown_and_near_one_when_balanced():
     assert 1.0 <= float(aux) < 4.0
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_ep_step_matches_unsharded_math(devices):
     mesh = create_mesh(MeshSpec(data=2, expert=4), devices)
     model = _moe_model()

@@ -79,6 +79,7 @@ def test_unsupported_block_falls_back():
 # ---- error feedback with kernels on --------------------------------------
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_error_feedback_telescopes_with_kernels(devices):
     """The EF telescoping identity (test_compression.py) must survive
     the fused wire kernels — and the whole trajectory (every hop's
@@ -369,6 +370,7 @@ def _trainer_end_state(kernels):
                            trainer.state.grad_residual))
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_trainer_kernels_bitwise_zero1_int8_ef(devices):
     """The acceptance contract: a full zero1 + int8-ring +
     error-feedback training run with --kernels leaves params, moments +

@@ -202,6 +202,7 @@ def test_scan_fused_health_carries_step_axis(devices):
     assert m["health"]["all_finite"].shape == (3,)
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_pipeline_parity_and_schema(devices):
     """GPipe: stage-sharded block stats psum over the pipe axis into the
     same global schema; recorder on vs off stays bit-identical."""
@@ -394,6 +395,7 @@ def test_trainer_halt_policy_drains(devices, tmp_path):
         os.path.join(str(tmp_path / "health_only"), "health-p0.jsonl"))
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_trainer_health_parity_and_warn_policy(devices):
     """Trainer-level parity: recorder on (warn) vs off, identical clean
     data -> bit-identical loss history and final params; warn leaves the

@@ -3,6 +3,7 @@ kernels. Pins causality itself, kernel-vs-reference parity inside the
 model, learning on a deterministic task, and SP == DP exactness with the
 cross-shard next-token shift."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -55,6 +56,7 @@ def test_lm_flash_matches_reference_attention():
                                atol=2e-5, rtol=0)
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_lm_learns_deterministic_next_token(devices):
     """Next-token = fixed permutation of the current token: a causal LM
     must drive the loss to ~0 quickly; an acausal or shifted-target bug
@@ -127,6 +129,7 @@ def test_sp_lm_loss_and_step_match_dp(devices):
                                    err_msg=jax.tree_util.keystr(pa))
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_sp_flash_lm_matches_plain_sp(devices):
     """sp_flash=True (Pallas causal flash ring tiles) agrees with the
     jnp causal ring on the same params/batch."""

@@ -132,6 +132,7 @@ def test_remat_step_matches_plain():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_wide_resnet_param_counts_match_published():
     """WRN-28-10 must count exactly 36,479,194 params (the paper's 36.5M,
     Zagoruyko & Komodakis 2016) and WRN-16-4 exactly 2,748,890 — a
@@ -146,6 +147,7 @@ def test_wide_resnet_param_counts_match_published():
         assert _count(variables["params"]) == expected, name
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_wide_resnet_trains_a_step():
     import numpy as np
 

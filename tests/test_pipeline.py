@@ -5,6 +5,7 @@ as the plain single-program ViT — same loss, same gradients — just laid out
 over stages.
 """
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -109,6 +110,7 @@ def test_pp_blocks_are_physically_staged(devices):
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_1f1b_matches_gpipe_exactly(devices):
     """Round-4 verdict item 5: the interleaved 1F1B schedule (manual
     backward, per-stage recompute, O(S) in-flight activations) must match
@@ -138,6 +140,7 @@ def test_1f1b_matches_gpipe_exactly(devices):
                                    err_msg=jax.tree_util.keystr(pa))
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_1f1b_matches_plain_vit_grads(devices):
     """The manual backward (ring-buffer recompute, per-micro head/embed
     vjps, explicit psum/pmean reduction) reproduces plain autodiff's

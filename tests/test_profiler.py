@@ -611,6 +611,7 @@ def test_train_config_profile_guards(tmp_path):
                 telemetry_dir=str(tmp_path)).validate()
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_trainer_config_window_end_to_end(tmp_path):
     """--profile-steps on a real (tiny) run: the bundle lands, carries
     the run metadata + measured window phases, the per-op attribution

@@ -126,6 +126,7 @@ def test_ring_flash_grads_match_full_attention(devices):
     _assert_grads_match(ring, q, k, v)
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_sp_flash_vit_matches_plain_sp(devices):
     """ViT(sp_flash=True) trains and its first-step loss agrees with the
     jnp-ring SP model (same math, different tiling)."""
@@ -296,6 +297,7 @@ def test_plain_ring_causal_matches_reference(devices):
                                    atol=5e-5, rtol=0)
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_ring_flash_causal_matches_reference(devices):
     """The flash ring's custom-VJP causal path (diagonal = static causal
     kernel tile; visible chunks full tiles; future chunks cond-skipped in

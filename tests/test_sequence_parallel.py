@@ -2,6 +2,7 @@
 mesh: SP loss must equal the non-SP loss on identical params/data, and a
 training step must run and reduce loss."""
 
+import pytest
 import jax
 import numpy as np
 from jax.sharding import PartitionSpec as P
@@ -31,6 +32,7 @@ def _setup(data=2, seq=4):
     return mesh, sp_model, ref_model, tx, state, batch
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_sp_loss_matches_non_sp(devices):
     mesh, sp_model, ref_model, tx, state, batch = _setup()
     step = make_sp_train_step(sp_model, tx, mesh, donate=False)

@@ -29,6 +29,7 @@ def _run(seed=0, **overrides) -> list:
     return trainer.history["train_loss"]
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_prefetched_epoch_matches_direct(devices):
     """prefetch_depth>0 must not change a single batch: loss history is
     bit-identical to the unprefetched run."""

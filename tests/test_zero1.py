@@ -302,6 +302,7 @@ def test_zero1_skip_step_guard(devices):
     assert bool(np.asarray(m2["health"]["all_finite"]))
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_zero1_lm_parity(devices):
     """The causal-LM DP step under zero1 matches the replicated one."""
     from tpu_ddp.models.lm import CausalTransformerLM
@@ -337,6 +338,7 @@ def test_zero1_lm_parity(devices):
     _trees_close(s_rep.opt_state, part.deshard_opt_state(s_z.opt_state))
 
 
+@pytest.mark.slow  # heavyweight compile - make test-all (tier-1 870s budget)
 def test_zero1_sp_lm_parity(devices):
     """Sequence-parallel LM on a (data=4, sequence=2) mesh: the zero1
     update (opt scattered over DATA, replicated over sequence) matches the

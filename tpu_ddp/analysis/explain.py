@@ -32,7 +32,7 @@ from tpu_ddp.analysis.roofline import RooflineReport, roofline
 
 #: the analyzer's strategy surface: every parallelism family, plus the
 #: dp-family layout variants that change the collective story
-STRATEGIES = ("dp", "zero1", "grad_compress", "sp", "fsdp", "tp",
+STRATEGIES = ("dp", "zero1", "zero3", "grad_compress", "sp", "fsdp", "tp",
               "fsdp_tp", "pp", "ep")
 
 # strategy -> sharded non-data axis lives in ONE place:
@@ -61,6 +61,15 @@ EXPECTED_FINGERPRINTS: Dict[str, Dict[str, Sequence]] = {
     # lower that as all-reduce + slice), params all-gather back
     "zero1": {"required": [[("reduce-scatter", None), ("all-reduce", None)],
                            [("all-gather", None)]],
+              "forbidden": ["collective-permute", "all-to-all"]},
+    # ZeRO-3 parameter streaming (the explicit-schedule counterpart of
+    # fsdp): per-block param all-gathers on the prefetch schedule, grads
+    # reduce-scatter straight into shard space; the backward is
+    # re-gather-free — the COL001 zero3 pin (analysis/lint.py) checks
+    # scope-level that NO all-gather lives outside the prefetch schedule,
+    # which a kind inventory cannot see
+    "zero3": {"required": [[("all-gather", None)],
+                           [("reduce-scatter", None), ("all-reduce", None)]],
               "forbidden": ["collective-permute", "all-to-all"]},
     # int8-quantized ring: the gradient sync is ppermute hops whose
     # payloads are s8 (scales ride separate small f32 transfers); the
@@ -251,7 +260,7 @@ def prepare_strategy_program(
     devices = list(devices if devices is not None else jax.devices())
     # zero1/grad_compress are dp-family layout variants; everything else
     # names its parallelism directly
-    parallelism = {"zero1": "dp", "grad_compress": "dp"}.get(
+    parallelism = {"zero1": "dp", "zero3": "dp", "grad_compress": "dp"}.get(
         strategy, strategy)
     axis = MODE_AXIS.get(strategy)
     if axis is None:
@@ -277,16 +286,17 @@ def prepare_strategy_program(
         else:
             model, model_name = _tiny_model(strategy, num_classes, dtype)
     zero1 = strategy == "zero1"
+    zero3 = strategy == "zero3"
     grad_compress = (
         {"mode": compress_mode, "block": compress_block,
          "error_feedback": False}
         if strategy == "grad_compress" else None
     )
     tx = make_optimizer(lr=1e-1, momentum=0.9,
-                        zero1_axis="data" if zero1 else None)
+                        zero1_axis="data" if (zero1 or zero3) else None)
     step, state = build_abstract_step(
         parallelism, model, tx, mesh, image_size=image_size, remat=remat,
-        grad_accum_steps=grad_accum_steps, zero1=zero1,
+        grad_accum_steps=grad_accum_steps, zero1=zero1, zero3=zero3,
         grad_compress=grad_compress, n_microbatches=n_microbatches,
         donate=donate,
     )
@@ -350,6 +360,8 @@ def run_strategy_label(meta: dict) -> str:
         mode = config.get("grad_compress", "none")
         if mode not in (None, "none"):
             return "grad_compress_bf16" if mode == "bf16" else "grad_compress"
+        if config.get("zero3"):
+            return "zero3"
         if config.get("zero1"):
             return "zero1"
     return strategy
@@ -375,13 +387,14 @@ def _run_meta_program(meta: dict, devices):
                          if k in fields})
     parallelism = meta.get("strategy", "dp")
     zero1 = bool(cfg.zero1)
+    zero3 = bool(getattr(cfg, "zero3", False))
     compress_on = cfg.grad_compress not in (None, "none")
-    if (zero1 or compress_on) and parallelism != "dp":
+    if (zero1 or zero3 or compress_on) and parallelism != "dp":
         raise ValueError(
             f"cannot rebuild a {parallelism}+"
-            f"{'zero1' if zero1 else 'grad-compress'} run abstractly "
-            "(build_abstract_step composes those with dp only); analyze "
-            "the family statically via --strategy instead"
+            f"{'zero1' if zero1 else 'zero3' if zero3 else 'grad-compress'} "
+            "run abstractly (build_abstract_step composes those with dp "
+            "only); analyze the family statically via --strategy instead"
         )
     # scan fusion is dp-only (the Trainer warns and ignores the flag for
     # every other family, trainer.py), so only dp runs actually compiled
@@ -401,7 +414,7 @@ def _run_meta_program(meta: dict, devices):
     # runs the chain on flattened shards, so the decay mask must be
     # precomputed on the original shapes
     decay_mask = None
-    if zero1 and cfg.weight_decay > 0:
+    if (zero1 or zero3) and cfg.weight_decay > 0:
         from tpu_ddp.train.optim import _decay_mask
         from tpu_ddp.train.state import init_model_variables
 
@@ -427,7 +440,7 @@ def _run_meta_program(meta: dict, devices):
         schedule=cfg.schedule,
         total_steps=max(1000, 2 * cfg.warmup_steps),
         warmup_steps=cfg.warmup_steps,
-        zero1_axis="data" if zero1 else None,
+        zero1_axis="data" if (zero1 or zero3) else None,
     )
     grad_compress = (
         {"mode": cfg.grad_compress, "block": cfg.grad_compress_block,
@@ -446,7 +459,7 @@ def _run_meta_program(meta: dict, devices):
         )
     step, state = build_abstract_step(
         parallelism, model, tx, mesh, remat=cfg.remat,
-        grad_accum_steps=cfg.grad_accum_steps, zero1=zero1,
+        grad_accum_steps=cfg.grad_accum_steps, zero1=zero1, zero3=zero3,
         grad_compress=grad_compress, n_microbatches=cfg.n_microbatches,
         health=health, pp_schedule=cfg.pp_schedule, sp_flash=cfg.sp_flash,
     )

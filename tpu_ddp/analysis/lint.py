@@ -434,6 +434,7 @@ def check_dtype_widening(audit: ProgramAudit,
 #: "any" = at least one big leaf (ep shards only the expert tensors)
 _SHARDED_SECTIONS = {
     "zero1": (("opt_state",), "fraction"),
+    "zero3": (("params", "opt_state"), "fraction"),
     "fsdp": (("params", "opt_state"), "fraction"),
     "fsdp_tp": (("params", "opt_state"), "fraction"),
     "ep": (("params",), "any"),
@@ -519,11 +520,120 @@ ORDER_PINS = {
     # a gather first would train on stale params
     "zero1": [("all-gather", ("reduce-scatter", "all-reduce"),
                "params must gather back AFTER the gradient sync")],
-    # ring attention rotates K/V during the forward; the grad sync
-    # all-reduce belongs to the update tail
-    "sp": [("all-reduce", ("collective-permute",),
-            "the ring rotation (forward) precedes the grad sync")],
+    # ZeRO-3: the step OPENS with the prefetch all-gathers (block 0's
+    # params are needed before anything computes); the grad sync belongs
+    # to the tail — a sync-first schedule means params were not streamed
+    "zero3": [("reduce-scatter", ("all-gather",),
+               "the grad reduce-scatter belongs after the prefetch "
+               "all-gathers (params stream in before anything computes)"),
+              ("all-reduce", ("all-gather",),
+               "every sync (loss/health/grad) belongs after the first "
+               "prefetch all-gather")],
 }
+
+
+# -- COL001 (zero3): the prefetch-schedule contract ------------------------
+
+_Z3_GATHER_RE = re.compile(r"[\]})] all-gather(?:-start)?\(")
+
+
+def _check_zero3_prefetch(audit: ProgramAudit) -> List[LintFinding]:
+    """The zero3 schedule contract, checked fail-closed on the COMPILED
+    program: every parameter block must have its own prefetch-scoped
+    all-gather group (``tpu_ddp.zero3_prefetch/b<k>`` — the named scopes
+    survive into the optimized HLO's op_name metadata), no all-gather may
+    live outside the prefetch schedule (an unscoped gather is either the
+    serialized just-in-time schedule or a backward re-gather, both of
+    which void the streaming claim), and the traced program must carry
+    the ``zero3_handoff`` optimization barriers that chain block k+1's
+    gather ahead of block k's first consuming op (XLA erases the barriers
+    after scheduling, so they are checked in the jaxpr, where the
+    double-buffer structure is still explicit). A program with none of
+    the scopes — e.g. the injected serialized gather — fails closed."""
+    from tpu_ddp.parallel.collectives import (
+        ZERO3_HANDOFF_SCOPE,
+        ZERO3_PREFETCH_SCOPE,
+    )
+    from tpu_ddp.parallel.zero import param_blocks
+
+    findings: List[LintFinding] = []
+    try:
+        n_blocks = len(param_blocks(audit.state.params)[1])
+    except Exception:
+        n_blocks = 0
+    prefetch_re = re.compile(re.escape(ZERO3_PREFETCH_SCOPE) + r"(\d+)")
+
+    first_pos: Dict[int, int] = {}
+    stray = 0
+    for pos, line in enumerate(audit.hlo_text.splitlines()):
+        if _Z3_GATHER_RE.search(line) is None:
+            continue
+        m = prefetch_re.search(line)
+        if m is not None:
+            first_pos.setdefault(int(m.group(1)), pos)
+        else:
+            stray += 1
+
+    if not first_pos:
+        findings.append(_finding(
+            "COL001", audit.program,
+            "zero3 prefetch schedule absent: no all-gather in the "
+            "compiled step carries a "
+            f"{ZERO3_PREFETCH_SCOPE}<k> scope — the parameter gathers "
+            "are serialized/just-in-time (or params were never "
+            "streamed), so the double-buffered overlap the --zero3 "
+            "contract promises does not exist in this program",
+        ))
+        return findings
+    missing = sorted(set(range(n_blocks)) - set(first_pos))
+    if missing:
+        findings.append(_finding(
+            "COL001", audit.program,
+            f"zero3 prefetch schedule incomplete: parameter blocks "
+            f"{missing} of {n_blocks} have no prefetch-scoped all-gather "
+            "in the compiled step (their params reach compute without a "
+            "scheduled gather slot)",
+        ))
+    if stray:
+        findings.append(_finding(
+            "COL001", audit.program,
+            f"zero3 re-gather: {stray} all-gather(s) outside the "
+            "prefetch schedule — the backward (or a second forward "
+            "assembly) is re-gathering full params; the zero3 contract "
+            "is ONE scheduled gather per block per step, grads "
+            "reduce-scatter straight into shard space",
+        ))
+
+    # the double-buffer handoff chain: checked in the TRACED program —
+    # barriers order the schedule, then XLA erases them post-scheduling
+    def _count_handoffs(jx) -> int:
+        count = 0
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "optimization_barrier":
+                ns = str(getattr(eqn.source_info, "name_stack", ""))
+                if ZERO3_HANDOFF_SCOPE in ns:
+                    count += 1
+            for v in eqn.params.values():
+                if hasattr(v, "eqns"):
+                    count += _count_handoffs(v)
+                elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+                    count += _count_handoffs(v.jaxpr)
+        return count
+
+    handoffs = 0
+    closed = getattr(audit.jaxpr, "jaxpr", audit.jaxpr)
+    if closed is not None and hasattr(closed, "eqns"):
+        handoffs = _count_handoffs(closed)
+    if n_blocks > 1 and handoffs < n_blocks - 1:
+        findings.append(_finding(
+            "COL001", audit.program,
+            f"zero3 double-buffer chain broken: {handoffs} "
+            f"{ZERO3_HANDOFF_SCOPE}<k> optimization barrier(s) in the "
+            f"traced step, expected >= {n_blocks - 1} (one per adjacent "
+            "block pair) — without the handoff ties nothing pins block "
+            "k+1's gather ahead of block k's first consuming op",
+        ))
+    return findings
 
 
 def check_collective_order(audit: ProgramAudit, cfg: LintConfig,
@@ -573,6 +683,10 @@ def check_collective_order(audit: ProgramAudit, cfg: LintConfig,
                 f"{first[late]}) precedes the first "
                 f"{'/'.join(early)} (#{early_first}) — {why}",
             ))
+    # zero3 carries its own schedule contract on top of the kind pins:
+    # per-block prefetch-scoped gathers, no stray gather, handoff chain
+    if audit.strategy == "zero3":
+        findings.extend(_check_zero3_prefetch(audit))
     # the pinned kind fingerprint (missing/forbidden kinds) is equally an
     # order-contract violation: an absent sync or a foreign collective
     from tpu_ddp.analysis.explain import check_fingerprint

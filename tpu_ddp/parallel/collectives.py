@@ -86,6 +86,69 @@ def ppermute(x, axis: str, perm):
     return lax.ppermute(x, axis_name=axis, perm=perm)
 
 
+#: scope prefixes the ZeRO-3 prefetch schedule stamps on its collectives.
+#: analysis/lint.py's COL001 zero3 pin greps the compiled HLO's op_name
+#: metadata (and the traced jaxpr's name stacks) for EXACTLY these — the
+#: schedule contract is carried in the program itself, not in a side
+#: channel, so a rebuilt/rescheduled program is re-audited for free.
+ZERO3_PREFETCH_SCOPE = "tpu_ddp.zero3_prefetch/b"
+ZERO3_HANDOFF_SCOPE = "tpu_ddp.zero3_handoff/b"
+ZERO3_SERIAL_SCOPE = "tpu_ddp.zero3_serial_gather"
+
+
+def prefetched_block_gather(blocks, axis: str, *, prefetch: bool = True):
+    """All-gather a layer-granular sequence of parameter blocks on the
+    ZeRO-3 double-buffered prefetch schedule.
+
+    ``blocks`` is a list of blocks, each a list of flat 1-D local shards
+    laid out like :class:`~tpu_ddp.parallel.zero.Zero1Partition`'s update
+    space (shard i owns rows ``[i*S, (i+1)*S)`` of the padded leaf).
+    Returns the same nesting with every shard all-gathered (tiled) back
+    to its full padded length.
+
+    With ``prefetch=True`` (the product schedule) block ``k+1``'s
+    gathers are ISSUED while block ``k`` is still the block about to
+    compute, then both are tied together with one
+    ``lax.optimization_barrier`` per boundary: block ``k``'s gathered
+    leaves only become available to their first consuming op through the
+    barrier that also carries block ``k+1``'s in-flight gather. That
+    makes the overlap window STRUCTURAL — no scheduler (XLA's
+    latency-hiding scheduler included) can sink the next block's
+    all-gather below the current block's compute — while keeping the
+    live-gathered set bounded at two blocks (current + next), which is
+    the whole HBM story of parameter streaming. Each gather carries a
+    ``tpu_ddp.zero3_prefetch/b<k>`` named scope and each boundary a
+    ``tpu_ddp.zero3_handoff/b<k>`` scope; the COL001 zero3 order pin
+    audits the compiled program by those names and fails closed when
+    they are absent.
+
+    ``prefetch=False`` is the serialized (no-lookahead) schedule kept
+    ONLY as the injected violation: every block gathered just-in-time
+    under one ``tpu_ddp.zero3_serial_gather`` scope, no handoff chain —
+    the program ``tools/zero3_demo.py`` feeds the linter to prove the
+    pin trips.
+    """
+    if not prefetch:
+        with jax.named_scope(ZERO3_SERIAL_SCOPE):
+            return [[all_gather(x, axis, tiled=True) for x in blk]
+                    for blk in blocks]
+
+    def gather_block(k):
+        with jax.named_scope(f"{ZERO3_PREFETCH_SCOPE}{k}"):
+            return [all_gather(x, axis, tiled=True) for x in blocks[k]]
+
+    out = []
+    cur = gather_block(0) if blocks else []
+    for k in range(len(blocks)):
+        nxt = gather_block(k + 1) if k + 1 < len(blocks) else None
+        if nxt is not None:
+            with jax.named_scope(f"{ZERO3_HANDOFF_SCOPE}{k}"):
+                cur, nxt = lax.optimization_barrier((cur, nxt))
+        out.append(cur)
+        cur = nxt
+    return out
+
+
 def ring_shift(x, axis: str, shift: int = 1):
     """Shift values around the ring on `axis` (neighbor exchange over ICI).
     Building block for ring attention / pipeline microbatch handoff."""

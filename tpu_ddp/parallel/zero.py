@@ -464,6 +464,263 @@ class Zero1Partition:
         }
 
 
+def param_blocks(params_template) -> tuple:
+    """Layer-granular prefetch blocks: param leaves grouped by their
+    TOP-LEVEL module key, in tree-flatten order.
+
+    Returns ``(block_names, blocks)`` where ``blocks[k]`` is the list of
+    flat-leaf indices belonging to block ``k``. This is THE block
+    partitioner — the ZeRO-3 prefetch schedule, its HBM accounting
+    (``Zero3Partition.accounting``), the memplan double-buffer row, and
+    the COL001 lint pin all derive their block count from this one
+    function, so they cannot disagree. It is a pure function of the tree
+    STRUCTURE (paths, not shapes/values), which is why the linter can
+    recompute it from the abstract state it audits: the flat scattered
+    layout preserves the original pytree paths.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params_template)[0]
+    names: list = []
+    blocks: list = []
+    index: dict = {}
+    for i, (path, _leaf) in enumerate(flat):
+        top = _path_str((path[0],)) if path else f"leaf{i}"
+        k = index.get(top)
+        if k is None:
+            k = index[top] = len(blocks)
+            names.append(top)
+            blocks.append([])
+        blocks[k].append(i)
+    return names, blocks
+
+
+class Zero3Partition(Zero1Partition):
+    """ZeRO-3 parameter streaming: the endpoint arxiv 2004.13336 points
+    at past its weight-update sharding — parameters live PERMANENTLY
+    scattered in the same per-leaf flat padded update space the ZeRO-1
+    partition defines (1/N param + 1/N optimizer HBM per chip), and the
+    forward re-assembles them block by block over a double-buffered
+    all-gather prefetch schedule
+    (``parallel/collectives.py::prefetched_block_gather``).
+
+    What changes vs :class:`Zero1Partition`:
+
+    * ``TrainState.params`` keeps its pytree STRUCTURE but each leaf is
+      the flat ``(padded,)`` 1-D array laid out ``P(axis)`` — exactly the
+      layout the update-space opt leaves already use, so the PR 18 fused
+      update kernels, the compressed reduce-scatter ring, and the
+      checkpoint de-shard path all compose without modification.
+    * The step's differentiation input is :meth:`stream_params`'s
+      gathered tree. The gather sits OUTSIDE the grad closure: AD never
+      sees it, so the backward is re-gather-free — gradients come out
+      full-shaped and LOCAL (the all-gather of varying shards is varying
+      on check_vma jax), which is precisely what ``reduce_scatter_mean``
+      consumes. No transpose collective, no second gather.
+    * :meth:`sharded_update` takes params that ARE already the local
+      shards and returns the updated shards — the ZeRO-1 tail minus its
+      ``local_shard`` slice at the front and minus the per-step
+      ``gather_params`` at the back.
+    * Checkpoints stay in the ONE de-sharded, device-count-independent
+      layout (``deshard_state`` also unflattens params), so ``--resume``
+      composes zero3 <-> zero1 <-> replicated and across device counts.
+    """
+
+    #: feature probe for the step builders / trainer routing: "params in
+    #: TrainState are flat 1/N shards, stream them" (Zero1 reads False
+    #: via getattr).
+    scattered_params = True
+
+    def __init__(self, tx, params_template, n_shards: int,
+                 axis: str = DATA_AXIS, compress=None,
+                 prefetch: bool = True):
+        super().__init__(tx, params_template, n_shards, axis=axis,
+                         compress=compress)
+        # dtype-carrying abstract template of the ORIGINAL layout (the
+        # slots only keep shapes) — accounting and shard/deshard need it
+        self.param_template = jax.eval_shape(lambda p: p, params_template)
+        self.prefetch = prefetch
+        self.block_names, self.blocks = param_blocks(self.param_template)
+        self.param_specs = jax.tree.map(
+            lambda _s: P(axis), self.param_slots, is_leaf=_is_slot
+        )
+
+    # ---- in-graph (inside shard_map) ------------------------------------
+
+    def stream_params(self, shard_tree, *, prefetch: Optional[bool] = None):
+        """This device's flat param shards -> the full original-shape
+        tree, gathered block by block on the prefetch schedule: block
+        ``k+1``'s all-gather is issued and barrier-tied before block
+        ``k``'s leaves reach their first consuming op, so the gather for
+        the next layer rides under the current layer's compute with at
+        most two blocks live in HBM. ``prefetch=False`` is the serialized
+        injection the lint demo trips COL001 with — never the product
+        path."""
+        from tpu_ddp.parallel.collectives import prefetched_block_gather
+
+        if prefetch is None:
+            prefetch = self.prefetch
+        leaves = jax.tree.leaves(shard_tree)
+        blocks = [[leaves[i] for i in blk] for blk in self.blocks]
+        gathered = prefetched_block_gather(blocks, self.axis,
+                                           prefetch=prefetch)
+        out = list(leaves)
+        for blk, g in zip(self.blocks, gathered):
+            for i, x in zip(blk, g):
+                out[i] = x
+        flat = jax.tree.unflatten(jax.tree.structure(shard_tree), out)
+        return self.unflatten(flat)
+
+    def sharded_update(self, grads, params, opt_state, residual=None,
+                       with_error: bool = False):
+        """The ZeRO-3 update tail: ``grads`` are the LOCAL full-shape
+        gradients out of the re-gather-free backward; ``params`` the flat
+        1/N shards straight from ``TrainState`` (no slice needed — they
+        never stopped being shards); the return's ``new_params`` are the
+        updated SHARDS (no gather — the next step's prefetch schedule is
+        the only place params are ever re-assembled)."""
+        gsh, err_state = self.reduce_scatter_mean(
+            grads, residual, with_error=with_error)
+        psh = params
+        with jax.named_scope("tpu_ddp.zero3_shard_update"):
+            fused = getattr(self.tx, "fused", None)
+            if fused is not None:
+                new_psh, updates, new_opt_state = fused.apply_sharded(
+                    gsh, opt_state, psh, partition=self)
+            else:
+                updates, new_opt_state = self.tx.update(gsh, opt_state, psh)
+                updates = self.mask_pad(updates)
+                new_psh = optax.apply_updates(psh, updates)
+        return new_psh, new_opt_state, gsh, updates, err_state
+
+    def health_stats(self, *, loss, grad_shards, params, update_shards,
+                     per_layer: bool = False, compress_error_sq=None):
+        """Zero1's schema from FULLY scattered state: ``params`` here are
+        this device's 1/N flat shards, so their norms psum over the axis
+        too (zero1 skips that psum because its params are replicated).
+        Every shard still reports the identical global number."""
+        psum = lambda x: lax.psum(x, self.axis)  # noqa: E731
+        pl = None
+        if per_layer:
+            pl = {
+                "grad_norm": {
+                    k: jnp.sqrt(psum(v))
+                    for k, v in per_layer_sq(grad_shards).items()
+                },
+                "param_norm": {
+                    k: jnp.sqrt(psum(v))
+                    for k, v in per_layer_sq(params).items()
+                },
+            }
+        return assemble_stats(
+            loss=loss,
+            grad_sq=psum(tree_sq(grad_shards)),
+            grad_bad=psum(tree_nonfinite(grad_shards)),
+            param_sq=psum(tree_sq(params)),
+            update_sq=psum(tree_sq(update_shards)),
+            update_bad=psum(tree_nonfinite(update_shards)),
+            per_layer=pl,
+            compress_error_sq=compress_error_sq,
+        )
+
+    # ---- specs / shardings (shard_map + device layout) ------------------
+
+    def state_specs(self, *, batch_stats_spec: Optional[P] = None):
+        """Like Zero1's, with params per-leaf ``P(axis)`` — the flat
+        scattered layout IS the steady-state training layout."""
+        from tpu_ddp.train.state import TrainState
+
+        return TrainState(
+            step=P(),
+            params=self.param_specs,
+            batch_stats=batch_stats_spec or P(),
+            opt_state=self.opt_specs,
+        )
+
+    def state_shardings(self, state, mesh: Mesh):
+        base = super().state_shardings(state, mesh)
+        return base.replace(
+            params=jax.tree.map(
+                lambda _, spec: NamedSharding(mesh, spec),
+                state.params, self.param_specs,
+            ),
+        )
+
+    # ---- checkpoint interop (de-shard <-> shard) ------------------------
+
+    def deshard_state(self, state):
+        """Full TrainState -> the ONE de-sharded checkpoint layout: opt
+        state via Zero1's path, params unpadded + reshaped back to their
+        original shapes. A --zero3 checkpoint restores into a replicated,
+        --zero1, or differently-sized --zero3 run byte-for-byte."""
+        state = super().deshard_state(state)
+        return state.replace(params=self.deshard_params(state.params))
+
+    def shard_params(self, params, mesh: Mesh):
+        """Original-layout params (fresh init or restored checkpoint) ->
+        flat ``(padded,)`` leaves laid out ``P(axis)``: the permanent
+        training layout."""
+        shardings = jax.tree.map(
+            lambda _s, spec: NamedSharding(mesh, spec),
+            self.param_slots, self.param_specs, is_leaf=_is_slot,
+        )
+        scatter = self._jitted(
+            ("shard_params", mesh), self.flatten, out_shardings=shardings,
+        )
+        return scatter(params)
+
+    def shard_state(self, state, mesh: Mesh):
+        """Full original-layout TrainState -> training layout: params AND
+        opt state scattered (vs Zero1, which keeps params replicated)."""
+        from tpu_ddp.parallel.mesh import replicated_sharding
+
+        rep = replicated_sharding(mesh)
+        return state.replace(
+            step=jax.device_put(state.step, NamedSharding(mesh, P())),
+            params=self.shard_params(state.params, mesh),
+            batch_stats=jax.device_put(state.batch_stats, rep),
+            opt_state=self.shard_opt_state(state.opt_state, mesh),
+        )
+
+    # ---- accounting (memplan / docs) ------------------------------------
+
+    def accounting(self) -> dict:
+        """Zero1's optimizer-state table plus the parameter story:
+        replicated vs 1/N per-device param bytes, and the prefetch
+        double-buffer high-water (the largest adjacent block pair's
+        gathered bytes — the bounded live-gathered set the schedule
+        guarantees)."""
+        acct = super().accounting()
+        slots = jax.tree.leaves(self.param_slots, is_leaf=_is_slot)
+        leaves = jax.tree.leaves(self.param_template)
+        block_of = {}
+        for k, blk in enumerate(self.blocks):
+            for i in blk:
+                block_of[i] = k
+        repl = shard = pad = 0
+        block_bytes = [0] * len(self.blocks)
+        for i, (slot, leaf) in enumerate(zip(slots, leaves)):
+            item = jnp.dtype(leaf.dtype).itemsize
+            repl += slot.size * item
+            shard += (slot.padded // self.n_shards) * item
+            pad += (slot.padded - slot.size) * item
+            block_bytes[block_of[i]] += slot.padded * item
+        if len(block_bytes) > 1:
+            prefetch_hw = max(
+                block_bytes[k] + block_bytes[k + 1]
+                for k in range(len(block_bytes) - 1)
+            )
+        else:
+            prefetch_hw = block_bytes[0] if block_bytes else 0
+        acct.update({
+            "params_bytes_replicated": int(repl),
+            "params_bytes_per_device_sharded": int(shard),
+            "params_padding_overhead_bytes_total": int(pad),
+            "n_blocks": len(self.blocks),
+            "block_names": list(self.block_names),
+            "prefetch_buffer_bytes": int(prefetch_hw),
+        })
+        return acct
+
+
 def clip_by_global_norm_sharded(
     max_norm: float, axis: str = DATA_AXIS
 ) -> optax.GradientTransformation:

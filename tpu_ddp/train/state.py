@@ -23,6 +23,12 @@ from flax import struct
 
 class TrainState(struct.PyTreeNode):
     step: jnp.ndarray
+    # Under --zero3 (parallel/zero.py::Zero3Partition) every params leaf
+    # is its flat (padded,) update-space row laid out P(data) — the tree
+    # STRUCTURE (and so every path-keyed consumer: decay masks, freeze
+    # labels, per-layer health) is unchanged; checkpoints always pass
+    # through deshard_state back to the original shapes, so the on-disk
+    # layout is one and device-count-independent.
     params: Any
     batch_stats: Any
     opt_state: Any

@@ -228,7 +228,19 @@ def _make_shard_step(
         # named scopes label the XLA ops so a jax.profiler device trace
         # (and the telemetry Chrome trace next to it) read the same phases
         if zero1 is not None:
-            p_in = zero1.varying(state.params)
+            if getattr(zero1, "scattered_params", False):
+                # ZeRO-3: params enter the step as flat 1/N shards; the
+                # differentiation input is re-assembled block by block on
+                # the double-buffered prefetch schedule (block k+1's
+                # all-gather rides under block k's compute —
+                # parallel/zero.py::Zero3Partition.stream_params). The
+                # gather sits OUTSIDE the grad closure, so the backward
+                # is re-gather-free: grads come out full-shaped and LOCAL
+                # (the gathered values are varying), exactly what the
+                # reduce-scatter below consumes.
+                p_in = zero1.stream_params(state.params)
+            else:
+                p_in = zero1.varying(state.params)
         elif compress is not None:
             p_in = compress.varying(state.params)
         else:
@@ -523,13 +535,25 @@ def make_grad_accum_train_step(
             batch,
         )
         grad_fn = jax.value_and_grad(compute_loss, has_aux=True)
-        zero_grads = jax.tree.map(jnp.zeros_like, state.params)
-        if zero1 is not None:
+        scattered = zero1 is not None and getattr(
+            zero1, "scattered_params", False)
+        if scattered:
+            # ZeRO-3: gather ONCE, outside the scan — every microbatch
+            # reuses the same streamed params (they only change at the
+            # update), and grads accumulate in the gathered (original)
+            # shapes, which is what the single post-scan reduce-scatter
+            # consumes.
+            p_in = zero1.stream_params(state.params)
+        elif zero1 is not None:
             p_in = zero1.varying(state.params)
         elif compress is not None:
             p_in = compress.varying(state.params)
         else:
             p_in = state.params
+        # under zero3 state.params are flat shards — the accumulator must
+        # match the GRADIENT shapes, i.e. the differentiation input's
+        zero_grads = jax.tree.map(
+            jnp.zeros_like, p_in if scattered else state.params)
 
         def accum(carry, micro):
             grads_acc, stats, correct, count, loss_sum, aux_sum = carry

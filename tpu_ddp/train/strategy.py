@@ -576,6 +576,7 @@ def build_abstract_step(
     remat: bool = False,
     grad_accum_steps: int = 1,
     zero1: bool = False,
+    zero3: bool = False,
     grad_compress: Optional[dict] = None,
     n_microbatches: int = 2,
     loss_fn: Callable = cross_entropy_loss,
@@ -624,12 +625,17 @@ def build_abstract_step(
             f"parallelism {parallelism!r} (pp schedules microbatches "
             "itself; sp's ring step owns its memory story)"
         )
-    if (zero1 or grad_compress) and parallelism != "dp":
+    if (zero1 or zero3 or grad_compress) and parallelism != "dp":
         raise ValueError(
-            "the abstract builder composes zero1/grad_compress with the "
-            f"dp family only, got parallelism {parallelism!r} (fsdp IS "
-            "ZeRO-3; tp/pp/ep own their layouts; live sp+zero1 routes "
-            "through build_strategy)"
+            "the abstract builder composes zero1/zero3/grad_compress with "
+            f"the dp family only, got parallelism {parallelism!r} (fsdp IS "
+            "GSPMD ZeRO-3; tp/pp/ep own their layouts; live sp+zero1 "
+            "routes through build_strategy)"
+        )
+    if zero1 and zero3:
+        raise ValueError(
+            "zero3 subsumes zero1 (params AND optimizer state live "
+            "scattered in the same flat update space); pass one"
         )
 
     if parallelism == "dp":
@@ -656,12 +662,18 @@ def build_abstract_step(
                 GradCompression(**grad_compress), state.params,
                 mesh.shape[DATA_AXIS],
             )
-        if zero1:
-            from tpu_ddp.parallel.zero import Zero1Partition
+        if zero1 or zero3:
+            from tpu_ddp.parallel.zero import Zero1Partition, Zero3Partition
 
-            part = Zero1Partition(tx, state.params, mesh.shape[DATA_AXIS],
-                                  compress=comp)
+            cls = Zero3Partition if zero3 else Zero1Partition
+            part = cls(tx, state.params, mesh.shape[DATA_AXIS],
+                       compress=comp)
             state = state.replace(opt_state=part.opt_template)
+            if zero3:
+                # zero3's steady state: params as flat 1/N update-space
+                # leaves (structure preserved, shapes (padded,))
+                state = state.replace(
+                    params=jax.eval_shape(part.flatten, state.params))
             shardings = part.state_shardings(state, mesh)
         if comp is not None and comp.config.error_feedback:
             state = state.replace(grad_residual=comp.residual_template())

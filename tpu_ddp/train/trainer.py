@@ -98,6 +98,20 @@ class TrainConfig:
                                           # params back. Same math as the
                                           # replicated update
                                           # (parallel/zero.py)
+    zero3: bool = False                   # ZeRO-3 parameter streaming
+                                          # (dp): params live PERMANENTLY
+                                          # scattered in the same flat
+                                          # update space (1/N param + 1/N
+                                          # optimizer HBM per chip); the
+                                          # forward re-assembles them
+                                          # block by block over a double-
+                                          # buffered all-gather prefetch
+                                          # schedule and the backward
+                                          # reduce-scatters grads straight
+                                          # into shard space — no full-
+                                          # param re-gather
+                                          # (parallel/zero.py::
+                                          # Zero3Partition)
     grad_compress: str = "none"           # none | bf16 | int8: quantize the
                                           # DP-family gradient sync's WIRE
                                           # payloads (block-scaled int8 ~4x
@@ -470,6 +484,26 @@ class TrainConfig:
                 f"{self.parallelism}: fsdp/fsdp_tp already scatter the "
                 "optimizer state (ZeRO-3 subsumes ZeRO-1); tp/pp/ep own "
                 "their state layout"
+            )
+        if self.zero3 and self.zero1:
+            raise ValueError(
+                "--zero3 subsumes --zero1 (parameters AND optimizer "
+                "state live scattered in the same flat update space); "
+                "drop --zero1"
+            )
+        if self.zero3 and self.optimizer == "lamb":
+            raise ValueError(
+                "--zero3 does not compose with --optimizer lamb (the "
+                "layer-wise trust ratio needs whole-parameter norms; "
+                "the 1/N update shards cannot provide them)"
+            )
+        if self.zero3 and self.parallelism not in (None, "dp"):
+            raise ValueError(
+                f"--zero3 is not supported with --parallelism "
+                f"{self.parallelism}: fsdp/fsdp_tp already stream "
+                "scattered parameters (GSPMD owns that schedule — use "
+                "them directly); tp/pp/ep/sp own their state layout. "
+                "Use --zero3 with dp"
             )
         from tpu_ddp.parallel.compression import MODES as compress_modes
 
@@ -863,7 +897,7 @@ class Trainer:
         # (see make_optimizer's zero1_axis).
         decay_mask = None
         zero1_axis = None
-        if config.zero1:
+        if config.zero1 or config.zero3:
             zero1_axis = DATA_AXIS
             if config.weight_decay > 0:
                 from tpu_ddp.train.optim import _decay_mask
@@ -912,6 +946,8 @@ class Trainer:
         self.state_shardings = None   # None == fully replicated (dp/sp)
         self._prepare_eval = None     # strategy hook (pp re-layouts params)
         self._zero1 = None            # Zero1Partition when --zero1
+                                      # (Zero3Partition when --zero3 —
+                                      # same interface, params scattered)
         self._compress = None         # GradCompressor when --grad-compress
         self._comm_bytes_per_step = None  # (wire, f32) per device per step
         if self.parallelism == "dp":
@@ -1088,7 +1124,8 @@ class Trainer:
         phase is the compressed collective (the params all-gather is
         unchanged), plain DP pays the full ring all-reduce."""
         acct = comp.accounting()
-        key = "reduce_scatter" if self.config.zero1 else "all_reduce"
+        zero_sharded = self.config.zero1 or self.config.zero3
+        key = "reduce_scatter" if zero_sharded else "all_reduce"
         self._comm_bytes_per_step = (
             acct[f"{key}_bytes_on_wire_per_device"],
             acct[f"{key}_bytes_f32_per_device"],
@@ -1114,7 +1151,9 @@ class Trainer:
     def _init_dp_steps(self, loss_fn, with_acc):
         """Flagship data-parallel path: shard_map DDP-semantics step, scan
         fusion, on-device augmentation, replicated state (``--zero1``:
-        replicated params, SCATTERED optimizer state)."""
+        replicated params, SCATTERED optimizer state; ``--zero3``: params
+        AND optimizer state scattered, forward streams params over the
+        prefetch schedule)."""
         config = self.config
         if config.pretrained_dir:
             from tpu_ddp.parallel.mesh import replicated_sharding
@@ -1129,40 +1168,51 @@ class Trainer:
                 ),
                 replicated_sharding(self.mesh),
             )
-        elif config.zero1:
-            # Fresh zero1 init: the SAME init recipe as create_train_state
-            # (init_model_variables — seed-parity with the replicated path
-            # depends on sharing it), but tx.init runs under out_shardings
-            # that scatter the update-space leaves — the replicated
-            # optimizer state (the HBM being saved) is never materialized,
-            # not even transiently at step 0.
+        elif config.zero1 or config.zero3:
+            # Fresh zero1/zero3 init: the SAME init recipe as
+            # create_train_state (init_model_variables — seed-parity with
+            # the replicated path depends on sharing it), but tx.init runs
+            # under out_shardings that scatter the update-space leaves —
+            # the replicated optimizer state (the HBM being saved) is
+            # never materialized, not even transiently at step 0. Under
+            # --zero3 the params themselves then move into the same flat
+            # scattered layout (the full init copy is transient, host-side
+            # model init being the unavoidable floor).
             import jax.numpy as jnp
 
             from tpu_ddp.parallel.mesh import replicated_sharding
-            from tpu_ddp.parallel.zero import Zero1Partition
+            from tpu_ddp.parallel.zero import Zero1Partition, Zero3Partition
             from tpu_ddp.train.state import TrainState, init_model_variables
 
             params, batch_stats = init_model_variables(
                 self.model, jax.random.key(config.seed))
             params = jax.device_put(params, replicated_sharding(self.mesh))
-            self._zero1 = Zero1Partition(
+            cls = Zero3Partition if config.zero3 else Zero1Partition
+            self._zero1 = cls(
                 self.tx, params, self.data_size, axis=DATA_AXIS)
+            opt_state = self._zero1.init_opt_state(params, self.mesh)
+            if config.zero3:
+                params = self._zero1.shard_params(params, self.mesh)
             self.state = TrainState(
                 step=jnp.zeros((), jnp.int32),
                 params=params,
                 batch_stats=jax.device_put(
                     batch_stats, replicated_sharding(self.mesh)),
-                opt_state=self._zero1.init_opt_state(params, self.mesh),
+                opt_state=opt_state,
             )
         else:
             self.state = create_train_state(
                 self.model, self.tx, jax.random.key(config.seed)
             )
-        if config.zero1:
+        if config.zero1 or config.zero3:
             if self._zero1 is None:  # finetune path: scatter the restored
-                from tpu_ddp.parallel.zero import Zero1Partition
+                from tpu_ddp.parallel.zero import (
+                    Zero1Partition,
+                    Zero3Partition,
+                )
 
-                self._zero1 = Zero1Partition(
+                cls = Zero3Partition if config.zero3 else Zero1Partition
+                self._zero1 = cls(
                     self.tx, self.state.params, self.data_size,
                     axis=DATA_AXIS,
                 )
@@ -1174,7 +1224,15 @@ class Trainer:
             # --grad-compress: the grad sync's wire payloads go int8/bf16
             # through the ppermute ring (parallel/compression.py); under
             # --zero1 the partition's reduce-scatter runs the same ring.
-            self._compress = self._build_compressor(self.state.params)
+            if self._zero1 is not None and getattr(
+                    self._zero1, "scattered_params", False):
+                # zero3: state.params are already flat shards — the
+                # compressor derives its per-leaf layout from the
+                # ORIGINAL shapes (the partition kept the template)
+                params_template = self._zero1.param_template
+            else:
+                params_template = self.state.params
+            self._compress = self._build_compressor(params_template)
             if self._zero1 is not None:
                 self._zero1.set_compression(self._compress)
             if config.grad_compress_error_feedback:
@@ -2554,13 +2612,15 @@ class Trainer:
         layout (one all-gather, eval cadence); the opt state itself is
         dropped from the eval input (the eval step reads only
         params/batch_stats, and its replicated in_specs must not force a
-        pointless gather of the shards)."""
+        pointless gather of the shards). Under --zero3 the live params
+        are flat shards too and get the same de-flatten."""
         s = self.state
         if s.grad_residual is not None:
             # the eval/predict steps read only params/batch_stats, and
             # their replicated in_specs must not force a re-layout of the
             # P(data)-scattered error-feedback residual
             s = s.replace(grad_residual=None)
+        swapped = False
         if self.config.ema_decay:
             from tpu_ddp.train.optim import find_ema
 
@@ -2569,7 +2629,13 @@ class Trainer:
                 if self._zero1 is not None:
                     ema = self._zero1.deshard_params(ema)
                 s = s.replace(params=ema)
+                swapped = True
         if self._zero1 is not None:
+            if getattr(self._zero1, "scattered_params", False) and not swapped:
+                # --zero3: the training params are flat 1/N shards; the
+                # eval step wants the original layout — one gather at
+                # eval cadence, same price zero1 pays every step
+                s = s.replace(params=self._zero1.deshard_params(s.params))
             s = s.replace(opt_state={})
         return self._prepare_eval(s) if self._prepare_eval else s
 

@@ -97,6 +97,7 @@ def winner_config_fields(priced, *, model_name: str, n_chans1: int,
         "parallelism": c.parallelism,
         "mesh": c.mesh_sizes(n_devices),
         "zero1": c.zero1,
+        "zero3": c.zero3,
         "grad_compress": c.grad_compress or "none",
         "per_shard_batch": c.per_shard_batch,
         "steps_per_call": c.steps_per_call,
@@ -131,6 +132,8 @@ def winner_cli_line(fields: dict) -> str:
         parts.append(f"--steps-per-call {fields['steps_per_call']}")
     if fields.get("zero1"):
         parts.append("--zero1")
+    if fields.get("zero3"):
+        parts.append("--zero3")
     if fields.get("grad_compress", "none") != "none":
         parts.append(f"--grad-compress {fields['grad_compress']}")
     if fields.get("kernels"):

@@ -28,13 +28,15 @@ from typing import Dict, List, Optional, Sequence, Tuple
 #: (analysis/explain.py::STRATEGIES) plus the composed dp overlay and
 #: the bf16 ring variant
 STRATEGY_TOKENS = (
-    "dp", "zero1", "grad_compress", "grad_compress_bf16",
-    "zero1+grad_compress", "fsdp", "tp", "fsdp_tp", "pp", "sp", "ep",
+    "dp", "zero1", "zero3", "grad_compress", "grad_compress_bf16",
+    "zero1+grad_compress", "zero3+grad_compress",
+    "fsdp", "tp", "fsdp_tp", "pp", "sp", "ep",
 )
 
 #: the dp-family layout overlays (all compile as parallelism "dp")
-OVERLAY_STRATEGIES = ("zero1", "grad_compress", "grad_compress_bf16",
-                      "zero1+grad_compress")
+OVERLAY_STRATEGIES = ("zero1", "zero3", "grad_compress",
+                      "grad_compress_bf16", "zero1+grad_compress",
+                      "zero3+grad_compress")
 
 # which parallelism families the grid may emit for a model comes from
 # the ONE support matrix beside the builders:
@@ -55,6 +57,11 @@ class Candidate:
     grad_compress: Optional[str]
     per_shard_batch: int
     steps_per_call: int
+    #: ZeRO-3 parameter streaming (``--zero3``): params live scattered
+    #: and the step prefetch-gathers them block by block. An HBM-relief
+    #: overlay, not a speedup — pricing only RANKS it when the
+    #: replicated twin is over the cap or slower (``replicated_fits``)
+    zero3: bool = False
     #: the fused Pallas kernel switch (``TrainConfig.kernels``). NOT in
     #: ``program_key()``: the fused tier is bit-identical to the XLA
     #: path by contract, so kernel-on/off variants deliberately share
@@ -75,8 +82,12 @@ class Candidate:
     @property
     def strategy_token(self) -> str:
         """The grid token this candidate enumerates under."""
+        if self.zero3 and self.grad_compress:
+            return "zero3+grad_compress"
         if self.zero1 and self.grad_compress:
             return "zero1+grad_compress"
+        if self.zero3:
+            return "zero3"
         if self.grad_compress == "bf16":
             return "grad_compress_bf16"
         if self.grad_compress:
@@ -100,6 +111,8 @@ class Candidate:
             return "grad_compress_bf16"
         if self.grad_compress:
             return "grad_compress"
+        if self.zero3:
+            return "zero3"
         if self.zero1:
             return "zero1"
         return self.parallelism
@@ -110,6 +123,8 @@ class Candidate:
         head = self.parallelism
         if self.zero1:
             head += "+zero1"
+        if self.zero3:
+            head += "+zero3"
         if self.grad_compress:
             head += f"+gc:{self.grad_compress}"
         if self.kernels:
@@ -124,7 +139,7 @@ class Candidate:
         against: everything but ``steps_per_call`` (scan-fused variants
         share the per-step program)."""
         return (self.parallelism, self.axis_size, self.zero1,
-                self.grad_compress, self.per_shard_batch)
+                self.zero3, self.grad_compress, self.per_shard_batch)
 
 
 def model_traits(model, image_size: int = 32) -> dict:
@@ -226,9 +241,11 @@ def enumerate_grid(
                 )
             continue
         zero1 = token in ("zero1", "zero1+grad_compress")
+        zero3 = token in ("zero3", "zero3+grad_compress")
         compress = {"grad_compress": "int8",
                     "grad_compress_bf16": "bf16",
-                    "zero1+grad_compress": "int8"}.get(token)
+                    "zero1+grad_compress": "int8",
+                    "zero3+grad_compress": "int8"}.get(token)
         from tpu_ddp.train.strategy import MODE_AXIS
 
         if MODE_AXIS.get(parallelism) is None:
@@ -252,5 +269,6 @@ def enumerate_grid(
                         parallelism=parallelism, axis_size=axis,
                         zero1=zero1, grad_compress=compress,
                         per_shard_batch=int(batch), steps_per_call=int(k),
+                        zero3=zero3,
                     ))
     return candidates

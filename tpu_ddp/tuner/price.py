@@ -60,6 +60,7 @@ STATUS_LINT = "lint"
 STATUS_COMPILE_ERROR = "compile_error"
 STATUS_UNPRICEABLE = "unpriceable"
 STATUS_INPUT_BOUND = "input_bound"
+STATUS_REPLICATED_FITS = "replicated_fits"
 
 
 @dataclasses.dataclass
@@ -96,6 +97,7 @@ class PricedCandidate:
             "parallelism": c.parallelism,
             "mesh": c.mesh_sizes(n_devices),
             "zero1": c.zero1,
+            "zero3": c.zero3,
             "grad_compress": c.grad_compress,
             "per_shard_batch": c.per_shard_batch,
             "steps_per_call": c.steps_per_call,
@@ -228,15 +230,16 @@ def prepare_candidate_program(
     mesh = create_mesh(MeshSpec(**cand.mesh_sizes(len(devices))), devices)
     # same optimizer knobs as prepare_strategy_program: the cache keys
     # only stay shared if the compiled programs really are identical
-    tx = make_optimizer(lr=1e-1, momentum=0.9,
-                        zero1_axis="data" if cand.zero1 else None)
+    tx = make_optimizer(
+        lr=1e-1, momentum=0.9,
+        zero1_axis="data" if (cand.zero1 or cand.zero3) else None)
     grad_compress = (
         {"mode": cand.grad_compress, "block": 256, "error_feedback": False}
         if cand.grad_compress else None
     )
     step, state = build_abstract_step(
         cand.parallelism, model, tx, mesh, image_size=image_size,
-        zero1=cand.zero1, grad_compress=grad_compress,
+        zero1=cand.zero1, zero3=cand.zero3, grad_compress=grad_compress,
         n_microbatches=n_microbatches,
     )
     key = _program_cache_key(
@@ -358,9 +361,10 @@ def price_anatomy(
     kernel_savings = None
     if cand.kernels and ops_model is not None and param_elements:
         parts = []
-        # fused_update sweeps the optimizer's own shard: the zero1
-        # scatter leaves each chip 1/data of the flat param space
-        shard = max(param_elements // (data if cand.zero1 else 1), 1)
+        # fused_update sweeps the optimizer's own shard: the zero1/
+        # zero3 scatter leaves each chip 1/data of the flat param space
+        sharded = cand.zero1 or cand.zero3
+        shard = max(param_elements // (data if sharded else 1), 1)
         s = ops_model.savings_s("fused_update", shard)
         if s is not None:
             parts.append(s)
@@ -371,8 +375,8 @@ def price_anatomy(
             # adds one more encode and data more decodes
             chunk = max(param_elements // data, 1)
             hops = data - 1
-            q_count = hops + (0 if cand.zero1 else 1)
-            d_count = hops + (0 if cand.zero1 else data)
+            q_count = hops + (0 if sharded else 1)
+            d_count = hops + (0 if sharded else data)
             for kname, count in (("fused_quant", q_count),
                                  ("fused_dequant", d_count)):
                 s = ops_model.savings_s(kname, chunk, count=count)
@@ -513,6 +517,46 @@ def tune(
             ops_model=ops_model, param_elements=n_params,
         )
         (ranked if priced.status == STATUS_OK else excluded).append(priced)
+    # zero3 is HBM relief, not a speedup: the streaming schedule pays
+    # prefetch all-gather wire bytes every step (priced above through
+    # the same roofline/comms model as every other collective) to free
+    # the replicated param residency. A zero3 candidate therefore only
+    # EARNS a rank when its replicated twin — the same grid point with
+    # zero3 off — is over the HBM cap or strictly slower; otherwise it
+    # is refused by name (`replicated_fits`), like an over_hbm row.
+    def _point(c: Candidate, zero3: bool) -> Tuple:
+        return (c.parallelism, c.axis_size, c.zero1, zero3,
+                c.grad_compress, c.per_shard_batch, c.steps_per_call,
+                c.kernels)
+
+    by_point = {_point(p.candidate, p.candidate.zero3): p
+                for p in ranked + excluded}
+    kept: List[PricedCandidate] = []
+    for priced in ranked:
+        c = priced.candidate
+        if not c.zero3:
+            kept.append(priced)
+            continue
+        twin = by_point.get(_point(c, False))
+        if (twin is not None and twin.status == STATUS_OK
+                and twin.effective_step_s is not None
+                and priced.effective_step_s is not None
+                and twin.effective_step_s <= priced.effective_step_s):
+            priced.status = STATUS_REPLICATED_FITS
+            priced.reason = (
+                f"replicated twin {twin.name} fits the HBM cap "
+                f"({twin.hbm_fraction:.1%} used) at "
+                f"{twin.effective_step_s * 1e6:.0f} us/step <= this "
+                f"candidate's {priced.effective_step_s * 1e6:.0f} us — "
+                "the prefetch all-gather wire bytes buy HBM this mesh "
+                "does not need" if twin.hbm_fraction is not None else
+                f"replicated twin {twin.name} prices at "
+                f"{twin.effective_step_s * 1e6:.0f} us/step <= this "
+                f"candidate's {priced.effective_step_s * 1e6:.0f} us")
+            excluded.append(priced)
+        else:
+            kept.append(priced)
+    ranked = kept
     ranked.sort(key=lambda p: (-p.predicted_images_per_sec_per_chip,
                                p.effective_step_s, p.name))
     return TuneResult(

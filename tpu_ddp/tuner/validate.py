@@ -33,7 +33,8 @@ DEFAULT_TRIAL_CALLS = 3
 #: program-shaping subset; everything else keeps its default)
 WINNER_CONFIG_FIELDS = (
     "model", "n_chans1", "n_blocks", "num_classes", "compute_dtype",
-    "parallelism", "mesh", "zero1", "grad_compress", "grad_compress_block",
+    "parallelism", "mesh", "zero1", "zero3", "grad_compress",
+    "grad_compress_block",
     "per_shard_batch", "steps_per_call", "n_devices", "n_microbatches",
     "kernels",
 )
