@@ -7,7 +7,7 @@ PYTHON ?= python
 	trace-demo health-demo zero-demo compress-demo analyze-demo \
 	lint-demo monitor-demo profile-demo goodput-demo registry-demo \
 	tune-demo mem-demo curves-demo chaos-demo comms-demo data-demo \
-	kernels-demo zero3-demo bench-compare
+	kernels-demo zero3-demo diagnose-demo bench-compare
 
 # Fast default loop (round-3 verdict item 5): skips the `slow`-marked
 # multi-process / end-to-end-CLI / AOT tests. CI and pre-commit should run
@@ -334,6 +334,24 @@ zero3-demo:
 	rm -rf $(ZERO3_DEMO_DIR)
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 	  $(PYTHON) -m tpu_ddp.tools.zero3_demo --dir $(ZERO3_DEMO_DIR)
+
+# Root-cause engine acceptance (docs/diagnose.md): on a 4-virtual-device
+# CPU mesh, `tpu-ddp diagnose` over a clean run must exit 0 with "no
+# suspect" while NAMING every absent observatory as a refusal; a chaos
+# data_stall, a live chaos comm_stall (diagnosed MID-stall from the hop
+# monitor's in-flight marker), and an injected all-NaN batch must each
+# yield exactly their own verdict — DIA001 naming the stalled stage,
+# DIA002 naming the wedged ring collective, DIA006 naming the poisoned
+# step — with no second rule riding along (cross-attribution fails the
+# demo); the clean artifact must `registry record` as kind "diagnose";
+# and `bench compare` must regress the clean baseline the moment a
+# fresh suspect class appears. Exits nonzero on any miss
+# (tpu_ddp/tools/diagnose_demo.py).
+DIAGNOSE_DEMO_DIR ?= /tmp/tpu_ddp_diagnose_demo
+diagnose-demo:
+	rm -rf $(DIAGNOSE_DEMO_DIR)
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+	  $(PYTHON) -m tpu_ddp.tools.diagnose_demo --dir $(DIAGNOSE_DEMO_DIR)
 
 # Deviceless perf-regression gate: re-capture the AOT artifact with the
 # real XLA:TPU toolchain (needs libtpu; ~30+ min of compiles) and diff

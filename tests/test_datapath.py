@@ -432,11 +432,11 @@ def test_report_sync_path_sums_to_data_wait(tmp_path):
     assert d["coverage"] == pytest.approx(1.0)
     assert "gather" in d["verdict"]
     assert data_main(["report", run]) == 0
-    # a run with no staged evidence is a named refusal, exit 1
+    # a run with no staged evidence is a named refusal, exit 2
     empty = tmp_path / "empty"
     empty.mkdir()
     _trace(empty, [("data_wait", 0.010)])
-    assert data_main(["report", str(empty)]) == 1
+    assert data_main(["report", str(empty)]) == 2
 
 
 def test_report_prefetch_verdicts(tmp_path):

@@ -124,6 +124,16 @@ def normalize_artifact(art, path: str = "<artifact>") -> Dict[str, dict]:
     if isinstance(art.get("anatomy"), dict):
         name = art["anatomy"].get("strategy", "anatomy")
         return {name: art["anatomy"]}
+    if "diagnose_schema_version" in art and isinstance(
+            art.get("diagnose"), dict):
+        # `tpu-ddp diagnose --json`: the per-DIA-rule suspect counts
+        # gate exactly through the shared rule-count channel — a fresh
+        # suspect class in a committed baseline is a regression by
+        # definition (the run found a NEW way to lose goodput)
+        diag = art["diagnose"]
+        return {"diagnose": {k: v for k, v in diag.items()
+                             if k not in ("verdicts", "sources",
+                                          "refusals")}}
     if isinstance(art.get("ledger"), dict):
         # `tpu-ddp goodput --json`: category PRESENCE gates exactly (a
         # fresh restart_gap category = the benched run started failing),

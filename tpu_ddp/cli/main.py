@@ -35,6 +35,11 @@ Subcommands:
   taxonomy (restart gaps, replayed steps, stalls, checkpoint/compile/
   data-wait costs), and recommends a Young–Daly checkpoint interval
   from measured save cost + MTBF (docs/goodput.md).
+- ``tpu-ddp diagnose <run_dir>`` — cross-observatory root-cause
+  engine: joins every artifact family the run left behind into one
+  evidence table and runs the DIA rule registry over it — a ranked
+  incident verdict with citations and a recommended action
+  (docs/diagnose.md).
 - ``tpu-ddp curves <run_dir>`` — convergence observatory: extract the
   run's learning curve (per-step loss/grad-norm from the health sinks
   across every incarnation, the eval-instant history from the trace);
@@ -194,6 +199,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from tpu_ddp.ledger.report import main as goodput_main
 
         return goodput_main(argv[1:])
+    # diagnose is stdlib-only end to end (cross-observatory file
+    # archaeology + the causal rule registry)
+    if argv[:1] == ["diagnose"]:
+        from tpu_ddp.diagnose.cli import main as diagnose_main
+
+        return diagnose_main(argv[1:])
     # mem is stdlib-only except the static-plan rebuild (lazy jax;
     # --no-plan keeps it import-free)
     if argv[:1] == ["mem"]:
@@ -298,6 +309,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="memory truth loop over a run dir: live-HBM timeline, "
              "measured-vs-planned reconciliation, OOM postmortems "
              "(tpu-ddp mem --help)",
+    )
+    sub.add_parser(
+        "diagnose",
+        help="cross-observatory root-cause verdict for a run dir: "
+             "every artifact family joined into one ranked, cited "
+             "incident report (tpu-ddp diagnose --help)",
     )
     sub.add_parser(
         "curves",

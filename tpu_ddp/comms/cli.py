@@ -171,11 +171,53 @@ def _cmd_exposure(args) -> int:
 
 
 def _cmd_forensics(args) -> int:
+    import os
+
     from tpu_ddp.comms.forensics import (
+        COMMS_HEALTH_SCHEMA_VERSION,
+        FORENSICS_PREFIX,
+        HANG_FORENSICS_SCHEMA_VERSION,
+        HEALTH_PREFIX,
         join_schedule,
         match_program_order,
         suspect_from_files,
     )
+
+    # refusal before verdict: no comms-health/hang-forensics files at
+    # all means there is nothing to judge (exit 2), distinct from
+    # "monitored but no suspect" (exit 1 below)
+    try:
+        names = sorted(os.listdir(args.run_dir))
+    except OSError as e:
+        print(f"tpu-ddp comms forensics: {e}", file=sys.stderr)
+        return 2
+    evidence = [
+        n for n in names
+        if (n.startswith(f"{HEALTH_PREFIX}-p")
+            or n.startswith(f"{FORENSICS_PREFIX}-p"))
+        and n.endswith(".json")]
+    if not evidence:
+        print(f"tpu-ddp comms forensics: no comms-health/hang-forensics "
+              f"files in {args.run_dir} — was the run started with "
+              "--comms-monitor?", file=sys.stderr)
+        return 2
+    for name in evidence:
+        try:
+            with open(os.path.join(args.run_dir, name)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for key, known in (
+                ("comms_health_schema_version",
+                 COMMS_HEALTH_SCHEMA_VERSION),
+                ("hang_forensics_schema_version",
+                 HANG_FORENSICS_SCHEMA_VERSION)):
+            v = rec.get(key)
+            if isinstance(v, int) and v > known:
+                print(f"tpu-ddp comms forensics: {name}: {key} {v} is "
+                      "newer than this tool understands "
+                      f"(knows <= {known})", file=sys.stderr)
+                return 2
 
     suspect = suspect_from_files(args.run_dir)
     order = join_schedule(args.run_dir)
@@ -191,8 +233,8 @@ def _cmd_forensics(args) -> int:
         return 0 if suspect else 1
     if suspect is None:
         print(f"comms forensics: no suspect collective in "
-              f"{args.run_dir} (no comms-health/hang-forensics files — "
-              "was the run started with --comms-monitor?)")
+              f"{args.run_dir} (the health files carry neither an "
+              "in-flight hop nor a last collective)")
         return 1
     print(f"comms forensics: suspect collective {suspect['key']} "
           f"(axis {suspect.get('axis')}, source {suspect.get('source')}"

@@ -12,7 +12,8 @@ The operator surface of the data-path observatory (docs/data.md):
   the same content digest; fail-closed naming the first diverging step
   (exit 1), exit 2 when there is nothing to audit.
 - ``report`` — decompose a run's measured ``data_wait`` into the
-  per-stage verdict (exit 1 when the run left no staged evidence).
+  per-stage verdict (exit 2 when the run left no staged evidence —
+  a refusal, following the house 0 / 1-finding / 2-refusal codes).
 """
 
 from __future__ import annotations
@@ -80,13 +81,19 @@ def _cmd_audit(args) -> int:
 def _cmd_report(args) -> int:
     from tpu_ddp.datapath.report import format_datapath_measured, report_run
 
-    rec = report_run(args.run_dir)
+    try:
+        rec = report_run(args.run_dir)
+    except (FileNotFoundError, ValueError) as e:
+        # future-schema trace artifacts and unreadable run dirs are
+        # refusals, not findings
+        print(f"tpu-ddp data report: {e}", file=sys.stderr)
+        return 2
     if args.json:
         print(json.dumps(rec, indent=2, sort_keys=True))
-        return 0 if rec["ok"] else 1
+        return 0 if rec["ok"] else 2
     if not rec["ok"]:
         print(f"tpu-ddp data report: {rec['error']}", file=sys.stderr)
-        return 1
+        return 2
     print(f"data report: {args.run_dir}")
     for line in format_datapath_measured(rec):
         print(line)

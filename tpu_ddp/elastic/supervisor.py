@@ -273,6 +273,18 @@ class Supervisor:
                     print(f"[elastic] hang forensics: suspect collective "
                           f"{suspect.get('key')} "
                           f"({suspect.get('source')})", flush=True)
+            # every death also gets the cross-observatory verdict: the
+            # DIA rule registry over whatever the dead incarnation left
+            # behind (docs/diagnose.md) — None is an honest "no suspect"
+            verdict = None
+            if exit_class != "clean":
+                from tpu_ddp.diagnose.rules import likely_cause
+
+                verdict = likely_cause(self.run_dir)
+                if verdict:
+                    print(f"[elastic] diagnose: {verdict['rule']} "
+                          f"{verdict['title']} — {verdict['message']}",
+                          flush=True)
             if exit_class == "clean" and rc == 0:
                 append_decision(self.run_dir, {
                     "event": "exit",
@@ -296,6 +308,7 @@ class Supervisor:
                     "incarnation": incarnation,
                     "exit_class": exit_class,
                     "suspect_collective": suspect,
+                    "diagnose": verdict,
                     "action": "stop",
                     "attempt": decision.attempt,
                     "reason": decision.reason,
@@ -330,6 +343,7 @@ class Supervisor:
                             "incarnation": incarnation,
                             "exit_class": exit_class,
                             "action": "stop",
+                            "diagnose": verdict,
                             "reason": f"re-mesh refused: {e} (no "
                                       "--fallback-plan given)",
                             "rc": rc,
@@ -349,6 +363,7 @@ class Supervisor:
                             "incarnation": incarnation,
                             "exit_class": exit_class,
                             "action": "stop",
+                            "diagnose": verdict,
                             "reason": (f"re-mesh refused: {refusal}; "
                                        f"fallback plan refused: {e2}"),
                             "rc": rc,
@@ -365,6 +380,7 @@ class Supervisor:
                     "incarnation": incarnation,
                     "exit_class": exit_class,
                     "action": "stop",
+                    "diagnose": verdict,
                     "reason": "no verifiable checkpoint to resume "
                               "from (every step refused by its "
                               "manifest)",
@@ -380,6 +396,7 @@ class Supervisor:
                 "incarnation": incarnation,
                 "exit_class": exit_class,
                 "suspect_collective": suspect,
+                "diagnose": verdict,
                 "action": "restart",
                 "attempt": decision.attempt,
                 "backoff_s": round(decision.backoff_s, 3),

@@ -44,6 +44,14 @@ def ledger_json(ledger: RunLedger,
     if decisions is None:
         decisions = elastic_decisions(ledger.run_dir)
     extra = {"elastic": {"decisions": decisions}} if decisions else {}
+    if ledger.categories.get("stall", 0.0) > 1e-9:
+        cause = _stall_attribution(ledger.run_dir)
+        if cause is not None:
+            extra["stall_attribution"] = {
+                "rule": cause["rule"],
+                "title": cause["title"],
+                "message": cause["message"],
+            }
     return {
         "schema_version": LEDGER_SCHEMA_VERSION,
         "type": "goodput_ledger",
@@ -81,6 +89,19 @@ def ledger_json(ledger: RunLedger,
             **extra,
         },
     }
+
+
+def _stall_attribution(run_dir: str) -> Optional[dict]:
+    """The ``stall`` bucket's cause: the top diagnose verdict (DIA rule
+    registry, docs/diagnose.md) when one exists. Report-only — the
+    taxonomy's sum-to-elapsed identity is untouched; this merely NAMES
+    what the already-booked stall seconds were."""
+    try:
+        from tpu_ddp.diagnose.rules import likely_cause
+
+        return likely_cause(run_dir)
+    except Exception:
+        return None
 
 
 def _data_wait_note(run_dir: str) -> str:
@@ -191,6 +212,11 @@ def render_ledger(ledger: RunLedger,
         share = secs / ledger.elapsed_s if ledger.elapsed_s else 0.0
         note = (_data_wait_note(ledger.run_dir)
                 if cat.name == "data_wait" and secs > 1e-9 else "")
+        if cat.name == "stall" and secs > 1e-9:
+            cause = _stall_attribution(ledger.run_dir)
+            if cause is not None:
+                note = (f"  <- {cause['rule']}: {cause['message']} "
+                        "(tpu-ddp diagnose)")
         lines.append(f"{cat.title:<38} {secs:>9.2f} {share:>7.1%}{note}")
     lines.append("-" * len(header))
     total_share = total / ledger.elapsed_s if ledger.elapsed_s else 0.0

@@ -291,6 +291,14 @@ def render_report(report: dict) -> str:
             f"{snap.get('run_dir')}`"
         )
 
+    # the diagnose join (--once only): one line naming the likely
+    # root cause from the DIA rule registry (docs/diagnose.md)
+    if "likely_cause" in report:
+        from tpu_ddp.diagnose.report import render_likely_cause
+
+        lines.append("")
+        lines.append(render_likely_cause(report["likely_cause"]))
+
     series = snap.get("loss_series") or []
     if series:
         from tpu_ddp.health.summarize import sparkline
@@ -445,6 +453,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         report = build_report(aggregator, engine)
         if rl is not None:
             _join_roofline(report, rl)
+        # one-shot mode reads a static run dir, so the full diagnose
+        # join is affordable: a single "likely cause" row from the DIA
+        # rule registry (docs/diagnose.md); None = no suspect
+        from tpu_ddp.diagnose.rules import likely_cause
+
+        report["likely_cause"] = likely_cause(args.path)
         print(json.dumps(report, indent=1) if args.json
               else render_report(report))
         return 1 if report["alerts"] else 0

@@ -173,6 +173,12 @@ def _artifact_kind(art: dict) -> str:
         # the seed-invariant quality digest, so N seeded runs of one
         # recipe land in ONE series
         return "curves"
+    if "diagnose_schema_version" in art or isinstance(
+            art.get("diagnose"), dict):
+        # `tpu-ddp diagnose --json`: the cross-observatory incident
+        # verdict (docs/diagnose.md) — recorded per config digest so
+        # the registry accumulates incident history
+        return "diagnose"
     if art.get("type") == "memtrack" or isinstance(art.get("mem"), dict):
         return "mem"
     if isinstance(art.get("ledger"), dict):
@@ -215,6 +221,7 @@ def _find_run_id(art: dict) -> Optional[str]:
     for path in (("provenance", "run_id"),
                  ("run_meta", "run_id"),
                  ("ledger", "run_id"),
+                 ("diagnose", "run_id"),
                  ("mem", "run_id"),
                  ("curve", "run_id"),
                  ("snapshot", "run_id")):

@@ -106,6 +106,18 @@ def main(argv=None) -> int:
         from tpu_ddp.registry.store import record_if_env
 
         record_if_env(artifact, note="analyze-demo")
+        # ... and the run's own root-cause verdict rides along, so the
+        # accumulated workspace can answer "did any gate see a suspect?"
+        from tpu_ddp.diagnose.cli import main as diagnose_main
+
+        diag_path = os.path.join(args.dir, "diagnose.json")
+        rc = diagnose_main([args.dir, "--out", diag_path])
+        if rc == 2:
+            print("[analyze-demo] FAIL: tpu-ddp diagnose refused the "
+                  "telemetry run dir", file=sys.stderr)
+            ok = False
+        else:
+            record_if_env(diag_path, note="analyze-demo diagnose verdict")
 
     # -- 3. every strategy's collective fingerprint -----------------------
     failures = []

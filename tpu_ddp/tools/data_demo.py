@@ -235,6 +235,17 @@ def check_report(run_dir: str) -> bool:
         return False
     print(f"[data-demo] report: {len(stages)} stages, dominant "
           f"'augment' as injected; summarize carries the datapath block")
+    # the stalled run's root-cause verdict rides into the CI registry
+    # workspace beside the loader baseline: the diagnose join must call
+    # the same run input-bound on the same stage the chaos spec wedged
+    diag_path = os.path.join(run_dir, "diagnose.json")
+    rc, out = _cli(["diagnose", run_dir, "--out", diag_path])
+    if rc == 2:
+        _fail(f"tpu-ddp diagnose refused the stall run dir: {out[-300:]}")
+        return False
+    from tpu_ddp.registry.store import record_if_env
+
+    record_if_env(diag_path, note="data-demo diagnose verdict")
     return True
 
 

@@ -282,6 +282,17 @@ def check_compare_gate(run_dir: str, scratch: str) -> bool:
     from tpu_ddp.registry.store import record_if_env
 
     record_if_env(new_path, note="goodput-demo incident ledger")
+    # ... and the incident run's root-cause verdict beside it, so the
+    # workspace pairs the ledger with WHY the goodput was lost
+    from tpu_ddp.diagnose.cli import main as diagnose_main
+
+    diag_path = os.path.join(scratch, "diagnose.json")
+    with contextlib.redirect_stdout(io.StringIO()):
+        rc_diag = diagnose_main([run_dir, "--out", diag_path])
+    if rc_diag == 2:
+        _fail("tpu-ddp diagnose refused the incident run dir")
+        return False
+    record_if_env(diag_path, note="goodput-demo diagnose verdict")
     ok = True
     with contextlib.redirect_stdout(io.StringIO()):
         rc_same = cli_main(["bench", "compare", new_path, new_path])
